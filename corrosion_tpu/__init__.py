@@ -1,0 +1,37 @@
+"""corrosion-tpu: a TPU-native framework with the capabilities of Corrosion.
+
+Corrosion (the reference, ``/root/reference``) is a gossip-based distributed
+SQLite system: every node holds a full SQLite database, local writes become
+CRDT changesets, disseminated by epidemic broadcast and reconciled by periodic
+anti-entropy sync, with SWIM cluster membership. This package rebuilds those
+capabilities natively for the TPU era:
+
+- ``corrosion_tpu.types``    — core data model: versions, range algebra, HLC
+  clocks, actors, changesets, sync-state algebra (ref: crates/corro-types,
+  crates/corro-base-types).
+- ``corrosion_tpu.crdt``     — the C++ SQLite CRDT engine (clock tables,
+  ``crsql_changes`` virtual table, site ids, causal length), the equivalent of
+  the bundled cr-sqlite extension (ref: crates/corro-types/src/sqlite.rs).
+- ``corrosion_tpu.agent``    — the per-node agent runtime: bookkeeping,
+  write pipeline, change application (ref: crates/corro-agent).
+- ``corrosion_tpu.swim``     — sans-IO SWIM membership core (ref: the `foca`
+  crate driven from crates/corro-agent/src/broadcast/mod.rs).
+- ``corrosion_tpu.transport``— datagram+stream transport (ref:
+  crates/corro-agent/src/transport.rs).
+- ``corrosion_tpu.broadcast``— epidemic broadcast runtime.
+- ``corrosion_tpu.sync``     — anti-entropy sync protocol (ref:
+  crates/corro-agent/src/api/peer.rs).
+- ``corrosion_tpu.api``      — public HTTP API (ref:
+  crates/corro-agent/src/api/public).
+- ``corrosion_tpu.pubsub``   — SQL subscription engine (ref:
+  crates/corro-types/src/pubsub.rs).
+- ``corrosion_tpu.sim``      — the TPU simulation/analysis backend: the whole
+  cluster as one JAX tensor program (lax.scan over a sharded cluster-state
+  tensor; SWIM + gossip + anti-entropy as batched sparse graph
+  message-passing). This is the capability the reference does not have.
+- ``corrosion_tpu.harness``  — in-process N-node cluster harness, the CPU
+  reference for the simulator (ref: crates/corro-devcluster,
+  configurable_stress_test in crates/corro-agent/src/agent/tests.rs).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+"""Core data model shared by the agent runtime and the TPU simulator.
+
+Equivalent of the reference's ``corro-base-types`` + ``corro-types`` crates.
+"""
+
+from .base import Version, CrsqlDbVersion, CrsqlSeq  # noqa: F401
+from .ranges import RangeSet  # noqa: F401
+from .clock import HLC, Timestamp  # noqa: F401
+from .actor import ActorId, ClusterId, Actor  # noqa: F401
+from .change import Change, SqliteValue, ChunkedChanges, MAX_CHANGES_BYTE_SIZE  # noqa: F401
+from .sync_state import SyncStateV1, SyncNeedFull, SyncNeedPartial  # noqa: F401

@@ -1,0 +1,68 @@
+"""Actor identity types.
+
+Equivalent of crates/corro-types/src/actor.rs: ``ActorId`` (a UUID), numeric
+``ClusterId``, and the SWIM ``Actor`` identity (id + gossip address +
+identity timestamp + cluster id).  ``Actor.renew()`` bumps the identity
+timestamp so a node declared down can rejoin immediately with a "newer"
+identity (ref: actor.rs:184-210); the SWIM core treats two actors with the
+same (id, addr) but different ``ts`` as successive incarnations of the same
+node.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, replace
+from typing import Tuple
+
+ClusterId = int  # u16
+
+
+class ActorId(bytes):
+    """16-byte actor id (UUID). Subclasses bytes for cheap hashing/ordering."""
+
+    def __new__(cls, value: bytes | str | uuid.UUID) -> "ActorId":
+        if isinstance(value, uuid.UUID):
+            value = value.bytes
+        elif isinstance(value, str):
+            value = uuid.UUID(value).bytes
+        if len(value) != 16:
+            raise ValueError(f"ActorId must be 16 bytes, got {len(value)}")
+        return super().__new__(cls, value)
+
+    @classmethod
+    def random(cls) -> "ActorId":
+        return cls(uuid.uuid4())
+
+    @classmethod
+    def zero(cls) -> "ActorId":
+        return cls(b"\x00" * 16)
+
+    def as_simple(self) -> str:
+        return uuid.UUID(bytes=bytes(self)).hex
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"ActorId({self.as_simple()})"
+
+
+@dataclass(frozen=True)
+class Actor:
+    """SWIM cluster identity (ref: actor.rs)."""
+
+    id: ActorId
+    addr: Tuple[str, int]  # (host, port) gossip address
+    ts: int  # NTP64 identity timestamp
+    cluster_id: ClusterId = 0
+
+    def renew(self, ts: int) -> "Actor":
+        """New incarnation of the same node (ref: actor.rs:199-210)."""
+        return replace(self, ts=ts)
+
+    def same_node(self, other: "Actor") -> bool:
+        return self.id == other.id and self.addr == other.addr
+
+    def newer_than(self, other: "Actor") -> bool:
+        return self.same_node(other) and self.ts > other.ts
+
+    def key(self) -> Tuple[ActorId, Tuple[str, int]]:
+        return (self.id, self.addr)

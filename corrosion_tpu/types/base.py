@@ -1,0 +1,26 @@
+"""Base newtypes for version/sequence arithmetic.
+
+Equivalent of the reference's ``corro-base-types`` crate
+(crates/corro-base-types/src/lib.rs:18): ``Version``, ``CrsqlDbVersion`` and
+``CrsqlSeq`` newtypes over u64.
+
+TPU-first note: in Python these are plain ``int`` aliases — the agent runtime
+treats them as opaque monotonic counters, and the simulator
+(:mod:`corrosion_tpu.sim`) maps the same quantities onto dense ``int32``/
+``uint32`` device arrays (per-actor head vectors, seq coverage bitmaps) where
+newtype wrappers would defeat vectorization.  The semantic distinction is:
+
+- ``Version``       — per-actor logical changeset number (1-based).  A
+  corrosion ``Version`` is the *originating* actor's db version for that
+  changeset.
+- ``CrsqlDbVersion``— a database-global Lamport-merged version counter
+  (1-based).
+- ``CrsqlSeq``      — 0-based sequence number of a single column-change row
+  within one changeset; used for chunking and partial reassembly.
+"""
+
+from typing import NewType
+
+Version = NewType("Version", int)
+CrsqlDbVersion = NewType("CrsqlDbVersion", int)
+CrsqlSeq = NewType("CrsqlSeq", int)

@@ -1,0 +1,141 @@
+"""Changeset data model + byte-budget chunker.
+
+Equivalent of crates/corro-api-types/src/lib.rs (``Change``, ``SqliteValue``)
+and crates/corro-types/src/change.rs (``ChunkedChanges``, 8 KiB default
+budget).
+
+A ``Change`` is one column-level CRDT delta as read from the
+``crsql_changes`` virtual table: (table, packed pk, column name, value,
+col_version, db_version, seq, site_id, cl).  ``ChunkedChanges`` slices an
+ordered-by-seq stream of changes into wire messages whose *estimated* byte
+size stays under a budget, tracking the covered seq range per chunk so that
+gaps (non-impactful rows skipped by the CRDT engine) are still accounted as
+covered — the receiving side's partial-version bookkeeping needs every seq to
+be claimed by exactly one chunk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Iterator, List, Optional, Tuple, Union
+
+# SqliteValue: None | int | float | str | bytes — mirrors the 5 SQLite
+# fundamental types (corro-api-types/src/lib.rs SqliteValue).
+SqliteValue = Union[None, int, float, str, bytes]
+
+MAX_CHANGES_BYTE_SIZE = 8 * 1024  # ref: change.rs:116
+
+
+def value_byte_size(val: SqliteValue) -> int:
+    """Wire-size estimate of a value (ref: corro-api-types lib.rs:558-566)."""
+    if val is None:
+        return 1 + 1
+    if isinstance(val, bool):  # pragma: no cover - bool is int in sqlite
+        return 1 + 8
+    if isinstance(val, int):
+        return 1 + 8
+    if isinstance(val, float):
+        return 1 + 8
+    if isinstance(val, str):
+        return 1 + 4 + len(val.encode("utf-8"))
+    return 1 + 4 + len(val)
+
+
+@dataclass(frozen=True)
+class Change:
+    """One column-level CRDT delta (ref: corro-api-types lib.rs:234-262)."""
+
+    table: str = ""
+    pk: bytes = b""
+    cid: str = ""
+    val: SqliteValue = None
+    col_version: int = 0
+    db_version: int = 0
+    seq: int = 0
+    site_id: bytes = b"\x00" * 16
+    cl: int = 0
+
+    def estimated_byte_size(self) -> int:
+        return (
+            len(self.table)
+            + len(self.pk)
+            + len(self.cid)
+            + value_byte_size(self.val)
+            + 8  # col_version
+            + 8  # db_version
+            + 8  # seq
+            + 16  # site_id
+            + 8  # cl
+        )
+
+    def is_delete_sentinel(self) -> bool:
+        """Row-deletion sentinel: cid is '-1' and causal length is even."""
+        return self.cid == "-1" and self.cl % 2 == 0
+
+
+class ChunkedChanges:
+    """Iterator of (changes, covered_seq_range) chunks under a byte budget.
+
+    Port of the reference semantics (crates/corro-types/src/change.rs:45-114):
+
+    - chunks are cut when the estimated buffered size reaches ``max_buf_size``
+      *and* more rows remain;
+    - the final chunk's range always extends to ``last_seq`` even if empty, so
+      the receiver can mark trailing non-impactful seqs as covered;
+    - seq gaps inside a chunk are implicitly covered by the chunk's range.
+
+    ``max_buf_size`` is mutable mid-iteration — the sync server shrinks it
+    adaptively 8 KiB → 1 KiB when sends are slow (peer.rs:641-654).
+    """
+
+    def __init__(
+        self,
+        iter_changes: Iterable[Change],
+        start_seq: int,
+        last_seq: int,
+        max_buf_size: int = MAX_CHANGES_BYTE_SIZE,
+    ) -> None:
+        self._iter = iter(iter_changes)
+        self._peeked: Optional[Change] = None
+        self._last_start_seq = start_seq
+        self._last_seq = last_seq
+        self.max_buf_size = max_buf_size
+        self._done = False
+
+    def _next_change(self) -> Optional[Change]:
+        if self._peeked is not None:
+            c, self._peeked = self._peeked, None
+            return c
+        return next(self._iter, None)
+
+    def _peek(self) -> Optional[Change]:
+        if self._peeked is None:
+            self._peeked = next(self._iter, None)
+        return self._peeked
+
+    def __iter__(self) -> Iterator[Tuple[List[Change], Tuple[int, int]]]:
+        return self
+
+    def __next__(self) -> Tuple[List[Change], Tuple[int, int]]:
+        if self._done:
+            raise StopIteration
+        changes: List[Change] = []
+        buffered_size = 0
+        last_pushed_seq = 0
+        while True:
+            change = self._next_change()
+            if change is None:
+                break
+            last_pushed_seq = change.seq
+            buffered_size += change.estimated_byte_size()
+            changes.append(change)
+            if last_pushed_seq == self._last_seq:
+                break  # that was the last seq, emit final chunk below
+            if buffered_size >= self.max_buf_size:
+                if self._peek() is None:
+                    break  # no more rows: emit final chunk below
+                start_seq = self._last_start_seq
+                self._last_start_seq = last_pushed_seq + 1
+                return (changes, (start_seq, last_pushed_seq))
+        self._done = True
+        return (changes, (self._last_start_seq, self._last_seq))

@@ -1,0 +1,83 @@
+"""Hybrid logical clock (HLC) over NTP64 timestamps.
+
+Equivalent of the `uhlc` crate the reference uses for causal timestamps
+(clock setup crates/corro-agent/src/agent/setup.rs:123-128: max_delta 300 ms;
+``Timestamp`` newtype crates/corro-types/src/broadcast.rs).
+
+A timestamp is a single u64 in NTP64 layout: upper 32 bits = seconds since
+the Unix epoch (we deliberately use the Unix era rather than the NTP era —
+only ordering matters inside one cluster), lower 32 bits = fractional
+seconds.  The lowest ``LOGICAL_BITS`` bits are stolen for the logical
+counter, exactly like uhlc's counter-in-fraction design, so timestamps stay
+totally ordered u64s that are cheap to ship on the wire and to batch into
+``uint64`` tensors in the simulator.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+LOGICAL_BITS = 8
+LOGICAL_MASK = (1 << LOGICAL_BITS) - 1
+DEFAULT_MAX_DELTA_MS = 300  # ref: setup.rs:123-128 (max_delta 300ms)
+
+Timestamp = int  # NTP64 u64
+
+
+def ntp64_from_unix_ns(ns: int) -> int:
+    secs, frac_ns = divmod(ns, 1_000_000_000)
+    frac = (frac_ns << 32) // 1_000_000_000
+    return ((secs << 32) | frac) & 0xFFFFFFFFFFFFFFFF
+
+
+def ntp64_to_unix_ns(ts: int) -> int:
+    secs = ts >> 32
+    frac = ts & 0xFFFFFFFF
+    return secs * 1_000_000_000 + ((frac * 1_000_000_000) >> 32)
+
+
+def ntp64_delta_ms(a: int, b: int) -> float:
+    """|a - b| in milliseconds."""
+    return abs(ntp64_to_unix_ns(a) - ntp64_to_unix_ns(b)) / 1e6
+
+
+class ClockDriftError(Exception):
+    """Remote timestamp is too far ahead of local physical time."""
+
+
+@dataclass
+class HLC:
+    """Hybrid logical clock producing monotonic NTP64 timestamps."""
+
+    max_delta_ms: int = DEFAULT_MAX_DELTA_MS
+    _last: int = 0
+
+    def _physical(self) -> int:
+        ts = ntp64_from_unix_ns(time.time_ns())
+        return ts & ~LOGICAL_MASK
+
+    def new_timestamp(self) -> Timestamp:
+        phys = self._physical()
+        if phys > self._last:
+            self._last = phys
+        else:
+            self._last += 1
+        return self._last
+
+    def peek(self) -> Timestamp:
+        return max(self._physical(), self._last)
+
+    def update_with_timestamp(self, ts: Timestamp) -> None:
+        """Merge a remote timestamp (sync clock exchange, peer.rs:997-1009).
+
+        Raises :class:`ClockDriftError` if the remote clock is more than
+        ``max_delta_ms`` ahead of our physical clock.
+        """
+        phys = self._physical()
+        if ts > phys and ntp64_delta_ms(ts, phys) > self.max_delta_ms:
+            raise ClockDriftError(
+                f"remote timestamp {ts} is {ntp64_delta_ms(ts, phys):.1f}ms ahead"
+            )
+        if ts > self._last:
+            self._last = ts

@@ -1,0 +1,142 @@
+"""Inclusive integer range-set algebra.
+
+Equivalent of the ``rangemap::RangeInclusiveSet`` the reference leans on for
+all version/sequence bookkeeping (e.g. crates/corro-types/src/sync.rs:125-247,
+crates/corro-types/src/agent.rs:1013-1187).  Stored ranges are closed
+``[start, end]`` intervals over non-negative ints; adjacent and overlapping
+ranges coalesce on insert (``[1,2]`` + ``[3,4]`` → ``[1,4]``), matching the
+coalescing behavior of ``RangeInclusiveSet`` over integer step types.
+
+This pure-Python structure is the *specification*; the TPU simulator models
+the same information as boolean coverage bitmaps / segment min-max tensors
+(see SURVEY.md §5 long-context notes), and
+``tests/test_ranges.py`` cross-checks the two representations.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right
+from typing import Iterable, Iterator, List, Tuple
+
+Range = Tuple[int, int]  # inclusive (start, end)
+
+
+class RangeSet:
+    """Sorted set of disjoint, non-adjacent inclusive integer ranges."""
+
+    __slots__ = ("_starts", "_ends")
+
+    def __init__(self, ranges: Iterable[Range] = ()) -> None:
+        self._starts: List[int] = []
+        self._ends: List[int] = []
+        for s, e in ranges:
+            self.insert(s, e)
+
+    # -- core mutation ----------------------------------------------------
+
+    def insert(self, start: int, end: int) -> None:
+        """Insert [start, end], coalescing with overlapping/adjacent ranges."""
+        if end < start:
+            return
+        # find window of existing ranges that overlap or touch [start-1, end+1]
+        i = bisect_left(self._ends, start - 1)
+        j = bisect_right(self._starts, end + 1)
+        if i < j:
+            start = min(start, self._starts[i])
+            end = max(end, self._ends[j - 1])
+        self._starts[i:j] = [start]
+        self._ends[i:j] = [end]
+
+    def remove(self, start: int, end: int) -> None:
+        """Remove [start, end], splitting partially-covered ranges."""
+        if end < start:
+            return
+        i = bisect_left(self._ends, start)
+        j = bisect_right(self._starts, end)
+        if i >= j:
+            return
+        keep_starts: List[int] = []
+        keep_ends: List[int] = []
+        if self._starts[i] < start:
+            keep_starts.append(self._starts[i])
+            keep_ends.append(start - 1)
+        if self._ends[j - 1] > end:
+            keep_starts.append(end + 1)
+            keep_ends.append(self._ends[j - 1])
+        self._starts[i:j] = keep_starts
+        self._ends[i:j] = keep_ends
+
+    def insert_all(self, other: "RangeSet") -> None:
+        for s, e in other:
+            self.insert(s, e)
+
+    # -- queries ----------------------------------------------------------
+
+    def __iter__(self) -> Iterator[Range]:
+        return iter(zip(self._starts, self._ends))
+
+    def __len__(self) -> int:
+        return len(self._starts)
+
+    def __bool__(self) -> bool:
+        return bool(self._starts)
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, RangeSet):
+            return NotImplemented
+        return self._starts == other._starts and self._ends == other._ends
+
+    def __repr__(self) -> str:
+        return f"RangeSet({list(self)!r})"
+
+    def contains(self, value: int) -> bool:
+        i = bisect_left(self._ends, value)
+        return i < len(self._starts) and self._starts[i] <= value
+
+    def contains_range(self, start: int, end: int) -> bool:
+        """True iff [start, end] is fully covered by a single stored range."""
+        if end < start:
+            return True
+        i = bisect_left(self._ends, start)
+        return i < len(self._starts) and self._starts[i] <= start and end <= self._ends[i]
+
+    def overlapping(self, start: int, end: int) -> Iterator[Range]:
+        """Stored ranges intersecting [start, end], in order."""
+        i = bisect_left(self._ends, start)
+        while i < len(self._starts) and self._starts[i] <= end:
+            yield (self._starts[i], self._ends[i])
+            i += 1
+
+    def gaps(self, start: int, end: int) -> Iterator[Range]:
+        """Maximal uncovered sub-ranges of [start, end], in order.
+
+        Mirrors ``RangeInclusiveSet::gaps`` as used for partial-changeset need
+        computation (crates/corro-types/src/sync.rs:310-318) and
+        ``BookedVersions::sync_need``.
+        """
+        cur = start
+        for s, e in self.overlapping(start, end):
+            if s > cur:
+                yield (cur, s - 1)
+            cur = max(cur, e + 1)
+            if cur > end:
+                return
+        if cur <= end:
+            yield (cur, end)
+
+    def last(self) -> int | None:
+        """Largest covered value, or None if empty."""
+        return self._ends[-1] if self._ends else None
+
+    def first(self) -> int | None:
+        return self._starts[0] if self._starts else None
+
+    def span_len(self) -> int:
+        """Total count of covered integers."""
+        return sum(e - s + 1 for s, e in self)
+
+    def copy(self) -> "RangeSet":
+        rs = RangeSet()
+        rs._starts = self._starts.copy()
+        rs._ends = self._ends.copy()
+        return rs

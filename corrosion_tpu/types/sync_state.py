@@ -1,0 +1,136 @@
+"""Anti-entropy sync state algebra.
+
+Equivalent of crates/corro-types/src/sync.rs: ``SyncStateV1`` (per-actor
+heads + full-version needs + partial seq needs) and
+``compute_available_needs`` — given our state and a peer's state, which of
+our needs can that peer actually serve.
+
+This pure version-set algebra is the *specification* for the vectorized
+bitmap implementation in :mod:`corrosion_tpu.sim.sync` (need masks as boolean
+tensors, head vectors as int32); ``tests/test_sync_state.py`` ports the
+reference's unit test (sync.rs:372-493) verbatim and the simulator tests
+cross-check against this module.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple, Union
+
+from .actor import ActorId
+from .ranges import Range, RangeSet
+
+
+@dataclass(frozen=True)
+class SyncNeedFull:
+    """Need whole versions [start, end] from an actor."""
+
+    versions: Range
+
+    def count(self) -> int:
+        return self.versions[1] - self.versions[0] + 1
+
+
+@dataclass(frozen=True)
+class SyncNeedPartial:
+    """Need seq sub-ranges of one partially-received version."""
+
+    version: int
+    seqs: Tuple[Range, ...]
+
+    def count(self) -> int:
+        return 1
+
+
+SyncNeed = Union[SyncNeedFull, SyncNeedPartial]
+
+
+@dataclass
+class SyncStateV1:
+    """What one node has/needs, per originating actor (ref: sync.rs:79-123)."""
+
+    actor_id: ActorId = ActorId.zero()
+    heads: Dict[ActorId, int] = field(default_factory=dict)
+    need: Dict[ActorId, List[Range]] = field(default_factory=dict)
+    partial_need: Dict[ActorId, Dict[int, List[Range]]] = field(default_factory=dict)
+
+    def need_len(self) -> int:
+        """Total count of needed versions (+ partial chunks / 50), ref sync.rs:88-107."""
+        full = sum(e - s + 1 for ranges in self.need.values() for (s, e) in ranges)
+        partial_seqs = sum(
+            e - s + 1
+            for partials in self.partial_need.values()
+            for ranges in partials.values()
+            for (s, e) in ranges
+        )
+        return full + partial_seqs // 50
+
+    def need_len_for_actor(self, actor_id: ActorId) -> int:
+        full = sum(e - s + 1 for (s, e) in self.need.get(actor_id, []))
+        return full + len(self.partial_need.get(actor_id, {}))
+
+    def compute_available_needs(
+        self, other: "SyncStateV1"
+    ) -> Dict[ActorId, List[SyncNeed]]:
+        """Which of *our* needs can `other` serve (ref: sync.rs:125-247).
+
+        For each actor the peer has data for:
+        1. peer's "haves" = [1, head] minus the peer's own needs and partials;
+        2. intersect our full needs with those haves;
+        3. our partials: fully served if the peer fully has the version,
+           else intersect seq-wise with what the peer has of its partial;
+        4. anything above our head up to the peer's head is needed in full.
+        """
+        needs: Dict[ActorId, List[SyncNeed]] = {}
+
+        for actor_id, head in other.heads.items():
+            if actor_id == self.actor_id:
+                continue
+            if head == 0:
+                continue
+
+            other_haves = RangeSet([(1, head)])
+            for s, e in other.need.get(actor_id, []):
+                other_haves.remove(s, e)
+            for v in other.partial_need.get(actor_id, {}):
+                other_haves.remove(v, v)
+
+            out = needs.setdefault(actor_id, [])
+
+            for rng in self.need.get(actor_id, []):
+                for os, oe in other_haves.overlapping(*rng):
+                    out.append(
+                        SyncNeedFull(versions=(max(rng[0], os), min(rng[1], oe)))
+                    )
+
+            for v, seqs in self.partial_need.get(actor_id, {}).items():
+                if other_haves.contains(v):
+                    out.append(SyncNeedPartial(version=v, seqs=tuple(seqs)))
+                else:
+                    other_seqs = other.partial_need.get(actor_id, {}).get(v)
+                    if other_seqs is None:
+                        continue
+                    ends = [e for (_, e) in other_seqs] + [e for (_, e) in seqs]
+                    if not ends:
+                        continue
+                    end = max(ends)
+                    other_seq_haves = RangeSet([(0, end)])
+                    for s, e in other_seqs:
+                        other_seq_haves.remove(s, e)
+                    overlap_seqs: List[Range] = []
+                    for rng in seqs:
+                        for os, oe in other_seq_haves.overlapping(*rng):
+                            overlap_seqs.append((max(rng[0], os), min(rng[1], oe)))
+                    if overlap_seqs:
+                        out.append(SyncNeedPartial(version=v, seqs=tuple(overlap_seqs)))
+
+            our_head = self.heads.get(actor_id)
+            if our_head is None:
+                out.append(SyncNeedFull(versions=(1, head)))
+            elif head > our_head:
+                out.append(SyncNeedFull(versions=(our_head + 1, head)))
+
+            if not out:
+                del needs[actor_id]
+
+        return needs

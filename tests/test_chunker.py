@@ -1,0 +1,61 @@
+"""Port of the reference's ChunkedChanges unit test
+(crates/corro-types/src/change.rs:118-258)."""
+
+from corrosion_tpu.types.change import Change, ChunkedChanges
+
+
+def mk(seq):
+    return Change(seq=seq)
+
+
+def test_change_chunker():
+    # empty iterator still yields one (empty) chunk covering the full range
+    chunker = ChunkedChanges([], 0, 100, 50)
+    assert list(chunker) == [([], (0, 100))]
+
+    changes = [mk(seq) for seq in range(100)]
+    size = changes[0].estimated_byte_size()
+
+    # 2 iterations
+    chunker = ChunkedChanges(changes[0:3], 0, 100, 2 * size)
+    assert list(chunker) == [
+        ([changes[0], changes[1]], (0, 1)),
+        ([changes[2]], (2, 100)),
+    ]
+
+    # last_seq reached early: stop even though iterator has more
+    chunker = ChunkedChanges(changes[0:2], 0, 0, size)
+    assert list(chunker) == [([changes[0]], (0, 0))]
+
+    # gaps inside a single chunk
+    chunker = ChunkedChanges([changes[0], changes[2]], 0, 100, 2 * size)
+    assert list(chunker) == [([changes[0], changes[2]], (0, 100))]
+
+    # gaps, all in one big chunk
+    chunker = ChunkedChanges(
+        [changes[2], changes[4], changes[7], changes[8]], 0, 100, 100000
+    )
+    assert list(chunker) == [
+        ([changes[2], changes[4], changes[7], changes[8]], (0, 100))
+    ]
+
+    # gaps across chunk boundaries
+    chunker = ChunkedChanges(
+        [changes[2], changes[4], changes[7], changes[8]], 0, 10, 2 * size
+    )
+    assert list(chunker) == [
+        ([changes[2], changes[4]], (0, 4)),
+        ([changes[7], changes[8]], (5, 10)),
+    ]
+
+
+def test_adaptive_buf_size():
+    """max_buf_size can shrink mid-iteration (sync server adaptive chunking)."""
+    changes = [mk(seq) for seq in range(10)]
+    size = changes[0].estimated_byte_size()
+    chunker = ChunkedChanges(changes, 0, 9, 4 * size)
+    first = next(chunker)
+    assert first == (changes[0:4], (0, 3))
+    chunker.max_buf_size = size
+    assert next(chunker) == ([changes[4]], (4, 4))
+    assert next(chunker) == ([changes[5]], (5, 5))

@@ -1,0 +1,46 @@
+"""HLC / NTP64 timestamp tests."""
+
+import pytest
+
+from corrosion_tpu.types.clock import (
+    HLC,
+    ClockDriftError,
+    ntp64_delta_ms,
+    ntp64_from_unix_ns,
+    ntp64_to_unix_ns,
+)
+
+
+def test_ntp64_roundtrip():
+    ns = 1_753_776_000_123_456_789
+    ts = ntp64_from_unix_ns(ns)
+    back = ntp64_to_unix_ns(ts)
+    assert abs(back - ns) < 10  # sub-nanosecond truncation of the 32-bit frac
+
+
+def test_monotonic():
+    clock = HLC()
+    stamps = [clock.new_timestamp() for _ in range(1000)]
+    assert stamps == sorted(stamps)
+    assert len(set(stamps)) == len(stamps)
+
+
+def test_update_with_remote():
+    clock = HLC()
+    t1 = clock.new_timestamp()
+    clock.update_with_timestamp(t1 + 1000)
+    assert clock.new_timestamp() > t1 + 1000
+
+
+def test_drift_rejected():
+    clock = HLC(max_delta_ms=300)
+    now = clock.new_timestamp()
+    far_future = ntp64_from_unix_ns(ntp64_to_unix_ns(now) + 10_000_000_000)
+    with pytest.raises(ClockDriftError):
+        clock.update_with_timestamp(far_future)
+
+
+def test_delta_ms():
+    a = ntp64_from_unix_ns(1_000_000_000_000)
+    b = ntp64_from_unix_ns(1_000_500_000_000)
+    assert abs(ntp64_delta_ms(a, b) - 500.0) < 0.01

@@ -1,0 +1,105 @@
+"""RangeSet algebra tests (spec for both bookkeeping and the sim bitmaps)."""
+
+import random
+
+from corrosion_tpu.types.ranges import RangeSet
+
+
+def test_insert_coalesce_adjacent():
+    rs = RangeSet()
+    rs.insert(1, 2)
+    rs.insert(3, 4)
+    assert list(rs) == [(1, 4)]
+    rs.insert(10, 12)
+    assert list(rs) == [(1, 4), (10, 12)]
+    rs.insert(5, 9)
+    assert list(rs) == [(1, 12)]
+
+
+def test_insert_overlap():
+    rs = RangeSet([(1, 5), (8, 10)])
+    rs.insert(4, 9)
+    assert list(rs) == [(1, 10)]
+    rs.insert(0, 20)
+    assert list(rs) == [(0, 20)]
+
+
+def test_remove_split():
+    rs = RangeSet([(1, 10)])
+    rs.remove(4, 6)
+    assert list(rs) == [(1, 3), (7, 10)]
+    rs.remove(1, 1)
+    assert list(rs) == [(2, 3), (7, 10)]
+    rs.remove(9, 30)
+    assert list(rs) == [(2, 3), (7, 8)]
+    rs.remove(0, 100)
+    assert list(rs) == []
+
+
+def test_remove_nonoverlapping_noop():
+    rs = RangeSet([(10, 20)])
+    rs.remove(1, 5)
+    rs.remove(25, 30)
+    assert list(rs) == [(10, 20)]
+
+
+def test_contains():
+    rs = RangeSet([(2, 5), (9, 9)])
+    assert rs.contains(2) and rs.contains(5) and rs.contains(9)
+    assert not rs.contains(1) and not rs.contains(6) and not rs.contains(10)
+    assert rs.contains_range(2, 5)
+    assert rs.contains_range(3, 4)
+    assert not rs.contains_range(2, 9)
+    assert not rs.contains_range(5, 6)
+
+
+def test_overlapping():
+    rs = RangeSet([(1, 3), (5, 7), (10, 12)])
+    assert list(rs.overlapping(2, 11)) == [(1, 3), (5, 7), (10, 12)]
+    assert list(rs.overlapping(4, 4)) == []
+    assert list(rs.overlapping(3, 5)) == [(1, 3), (5, 7)]
+
+
+def test_gaps():
+    rs = RangeSet([(3, 5), (8, 9)])
+    assert list(rs.gaps(1, 12)) == [(1, 2), (6, 7), (10, 12)]
+    assert list(rs.gaps(3, 9)) == [(6, 7)]
+    assert list(rs.gaps(4, 8)) == [(6, 7)]
+    empty = RangeSet()
+    assert list(empty.gaps(0, 4)) == [(0, 4)]
+    full = RangeSet([(0, 10)])
+    assert list(full.gaps(0, 10)) == []
+
+
+def test_last_first_span():
+    rs = RangeSet([(3, 5), (8, 9)])
+    assert rs.last() == 9
+    assert rs.first() == 3
+    assert rs.span_len() == 5
+    assert RangeSet().last() is None
+
+
+def test_randomized_against_set_model():
+    """Cross-check RangeSet against a plain python set-of-ints model."""
+    rng = random.Random(42)
+    rs = RangeSet()
+    model = set()
+    for _ in range(2000):
+        s = rng.randrange(0, 200)
+        e = s + rng.randrange(0, 20)
+        if rng.random() < 0.6:
+            rs.insert(s, e)
+            model.update(range(s, e + 1))
+        else:
+            rs.remove(s, e)
+            model.difference_update(range(s, e + 1))
+        # invariants: disjoint, non-adjacent, sorted
+        prev_end = None
+        covered = set()
+        for rs_s, rs_e in rs:
+            assert rs_s <= rs_e
+            if prev_end is not None:
+                assert rs_s > prev_end + 1
+            prev_end = rs_e
+            covered.update(range(rs_s, rs_e + 1))
+        assert covered == model

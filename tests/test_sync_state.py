@@ -1,0 +1,98 @@
+"""Port of the reference's compute_available_needs unit test
+(crates/corro-types/src/sync.rs:372-493) plus extras."""
+
+from corrosion_tpu.types.actor import ActorId
+from corrosion_tpu.types.sync_state import (
+    SyncNeedFull,
+    SyncNeedPartial,
+    SyncStateV1,
+)
+
+
+def test_compute_available_needs():
+    actor1 = ActorId.random()
+
+    our_state = SyncStateV1(actor_id=ActorId.random())
+    our_state.heads[actor1] = 10
+
+    other_state = SyncStateV1(actor_id=ActorId.random())
+    other_state.heads[actor1] = 13
+
+    assert our_state.compute_available_needs(other_state) == {
+        actor1: [SyncNeedFull(versions=(11, 13))]
+    }
+
+    our_state.need.setdefault(actor1, []).append((2, 5))
+    our_state.need.setdefault(actor1, []).append((7, 7))
+
+    assert our_state.compute_available_needs(other_state) == {
+        actor1: [
+            SyncNeedFull(versions=(2, 5)),
+            SyncNeedFull(versions=(7, 7)),
+            SyncNeedFull(versions=(11, 13)),
+        ]
+    }
+
+    our_state.partial_need[actor1] = {9: [(100, 120), (130, 132)]}
+
+    assert our_state.compute_available_needs(other_state) == {
+        actor1: [
+            SyncNeedFull(versions=(2, 5)),
+            SyncNeedFull(versions=(7, 7)),
+            SyncNeedPartial(version=9, seqs=((100, 120), (130, 132))),
+            SyncNeedFull(versions=(11, 13)),
+        ]
+    }
+
+    # peer itself only partially has version 9
+    other_state.partial_need[actor1] = {9: [(100, 110), (130, 130)]}
+
+    assert our_state.compute_available_needs(other_state) == {
+        actor1: [
+            SyncNeedFull(versions=(2, 5)),
+            SyncNeedFull(versions=(7, 7)),
+            SyncNeedPartial(version=9, seqs=((111, 120), (131, 132))),
+            SyncNeedFull(versions=(11, 13)),
+        ]
+    }
+
+
+def test_zero_head_ignored():
+    actor1 = ActorId.random()
+    ours = SyncStateV1(actor_id=ActorId.random())
+    other = SyncStateV1(actor_id=ActorId.random())
+    other.heads[actor1] = 0
+    assert ours.compute_available_needs(other) == {}
+
+
+def test_own_actor_skipped():
+    me = ActorId.random()
+    ours = SyncStateV1(actor_id=me)
+    other = SyncStateV1(actor_id=ActorId.random())
+    other.heads[me] = 50
+    assert ours.compute_available_needs(other) == {}
+
+
+def test_peer_needs_create_gaps():
+    """Versions the peer itself is missing must not be requested from it."""
+    actor1 = ActorId.random()
+    ours = SyncStateV1(actor_id=ActorId.random())
+    ours.heads[actor1] = 10
+    ours.need[actor1] = [(3, 8)]
+    other = SyncStateV1(actor_id=ActorId.random())
+    other.heads[actor1] = 10
+    other.need[actor1] = [(5, 6)]
+    assert ours.compute_available_needs(other) == {
+        actor1: [SyncNeedFull(versions=(3, 4)), SyncNeedFull(versions=(7, 8))]
+    }
+
+
+def test_need_len():
+    actor1 = ActorId.random()
+    st = SyncStateV1(actor_id=ActorId.random())
+    st.need[actor1] = [(1, 10), (20, 20)]
+    st.partial_need[actor1] = {30: [(0, 99)]}
+    # 10 + 1 full versions + 100 partial seqs / 50 = 13
+    assert st.need_len() == 13
+    assert st.need_len_for_actor(actor1) == 12
+    assert st.need_len_for_actor(ActorId.random()) == 0

@@ -1,0 +1,63 @@
+"""Native CRDT engine: C++ SQLite extension + connection helpers.
+
+Equivalent of the reference's bundled cr-sqlite extension and its loader
+(crates/corro-types/src/sqlite.rs:15-109 ``CrConn``/``rusqlite_to_crsqlite``).
+``connect()`` returns a sqlite3.Connection with the engine loaded, standard
+pragmas applied, and auxiliary scalar functions registered (the equivalent
+of crates/sqlite-functions ``corro_json_contains``).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+from typing import Optional
+
+from .build import build
+
+
+def _json_contains(selector: Optional[str], obj: Optional[str]) -> bool:
+    """corro_json_contains(selector, object): is `selector` fully contained
+    in `object`?  Objects: every selector key exists in object with a
+    recursively-contained value; everything else (incl. arrays): equality.
+    Matches crates/sqlite-functions/src/lib.rs:32-51 and its tests.
+    """
+    try:
+        vs = json.loads(selector) if selector is not None else None
+        vo = json.loads(obj) if obj is not None else None
+    except (TypeError, ValueError):
+        return False
+
+    def contained(s, o) -> bool:
+        if isinstance(s, dict) and isinstance(o, dict):
+            return all(k in o and contained(v, o[k]) for k, v in s.items())
+        return s == o
+
+    return contained(vs, vo)
+
+
+def setup_conn(conn: sqlite3.Connection) -> sqlite3.Connection:
+    """Apply the standard per-connection pragmas (ref: sqlite.rs setup_conn)."""
+    conn.executescript(
+        """
+        PRAGMA journal_mode = WAL;
+        PRAGMA synchronous = NORMAL;
+        PRAGMA busy_timeout = 5000;
+        PRAGMA foreign_keys = OFF;
+        """
+    )
+    conn.create_function("corro_json_contains", 2, _json_contains, deterministic=True)
+    return conn
+
+
+def connect(path: str, load_crdt: bool = True) -> sqlite3.Connection:
+    """Open a database with the CRDT engine loaded (ref: CrConn::init)."""
+    conn = sqlite3.connect(path, timeout=5.0, check_same_thread=False)
+    conn.isolation_level = None  # explicit transaction control
+    setup_conn(conn)
+    if load_crdt:
+        so = build()
+        conn.enable_load_extension(True)
+        conn.load_extension(so)
+        conn.enable_load_extension(False)
+    return conn

@@ -1,0 +1,76 @@
+"""Build the native CRDT engine (crsqlite.so) with g++.
+
+The extension links against the same libsqlite3.so.0 that Python's _sqlite3
+module uses, so all SQLite API calls inside the extension operate on the
+same library state as the host connection.  Headers come from the
+tensorflow wheel's bundled sqlite3.h (3.50); only stable, ancient APIs are
+used so the 3.40 runtime is fine.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "src", "crsqlite.cpp")
+OUT = os.path.join(HERE, "crsqlite.so")
+
+_INCLUDE_CANDIDATES = [
+    "/opt/venv/lib/python3.12/site-packages/tensorflow/include/external/org_sqlite",
+    "/usr/include",
+]
+_LIB_CANDIDATES = [
+    "/lib/x86_64-linux-gnu/libsqlite3.so.0",
+    "/usr/lib/x86_64-linux-gnu/libsqlite3.so.0",
+]
+
+
+def find_include() -> str:
+    for d in _INCLUDE_CANDIDATES:
+        if os.path.exists(os.path.join(d, "sqlite3.h")):
+            return d
+    raise RuntimeError("sqlite3.h not found; checked " + str(_INCLUDE_CANDIDATES))
+
+
+def find_lib() -> str:
+    for f in _LIB_CANDIDATES:
+        if os.path.exists(f):
+            return f
+    raise RuntimeError("libsqlite3.so.0 not found")
+
+
+def build(force: bool = False) -> str:
+    """Compile crsqlite.so if missing or stale; return its path."""
+    if (
+        not force
+        and os.path.exists(OUT)
+        and os.path.getmtime(OUT) >= os.path.getmtime(SRC)
+    ):
+        return OUT
+    cmd = [
+        "g++",
+        "-std=c++17",
+        "-O2",
+        "-fPIC",
+        "-shared",
+        "-Wall",
+        "-I",
+        find_include(),
+        "-o",
+        OUT,
+        SRC,
+        find_lib(),
+    ]
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"g++ failed building crsqlite.so (exit {res.returncode}):\n{res.stderr}"
+        )
+    return OUT
+
+
+if __name__ == "__main__":
+    path = build(force=True)
+    print(path)

@@ -1,0 +1,81 @@
+"""Primary-key column packing — Python mirror of the C++ engine's format.
+
+The engine's ``crsql_changes.pk`` column is a blob encoding the pk value
+tuple (equivalent of the reference's pack_columns/unpack_columns,
+crates/corro-types/src/pubsub.rs:2197-2289, which mirrors cr-sqlite's
+format; ours is a fresh format shared by crsqlite.cpp's pack_value /
+unpack_columns — keep the two in sync).
+
+Format, per value: 1 tag byte then payload:
+  0x00 NULL
+  0x01 int64, 8 bytes big-endian (two's complement)
+  0x02 float64, 8 bytes big-endian IEEE-754
+  0x03 text, u32 BE length + utf-8 bytes
+  0x04 blob, u32 BE length + bytes
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Sequence
+
+from .change import SqliteValue
+
+
+def pack_columns(values: Sequence[SqliteValue]) -> bytes:
+    out = bytearray()
+    for v in values:
+        if v is None:
+            out.append(0x00)
+        elif isinstance(v, bool):
+            out.append(0x01)
+            out += struct.pack(">q", int(v))
+        elif isinstance(v, int):
+            out.append(0x01)
+            out += struct.pack(">q", v)
+        elif isinstance(v, float):
+            out.append(0x02)
+            out += struct.pack(">d", v)
+        elif isinstance(v, str):
+            b = v.encode("utf-8")
+            out.append(0x03)
+            out += struct.pack(">I", len(b))
+            out += b
+        elif isinstance(v, (bytes, bytearray, memoryview)):
+            b = bytes(v)
+            out.append(0x04)
+            out += struct.pack(">I", len(b))
+            out += b
+        else:
+            raise TypeError(f"unsupported pk value type: {type(v)}")
+    return bytes(out)
+
+
+def unpack_columns(buf: bytes) -> List[SqliteValue]:
+    out: List[SqliteValue] = []
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag = buf[pos]
+        pos += 1
+        if tag == 0x00:
+            out.append(None)
+        elif tag == 0x01:
+            (v,) = struct.unpack_from(">q", buf, pos)
+            pos += 8
+            out.append(v)
+        elif tag == 0x02:
+            (v,) = struct.unpack_from(">d", buf, pos)
+            pos += 8
+            out.append(v)
+        elif tag in (0x03, 0x04):
+            (ln,) = struct.unpack_from(">I", buf, pos)
+            pos += 4
+            raw = buf[pos : pos + ln]
+            if len(raw) != ln:
+                raise ValueError("truncated pk blob")
+            pos += ln
+            out.append(raw.decode("utf-8") if tag == 0x03 else bytes(raw))
+        else:
+            raise ValueError(f"bad pk tag {tag:#x} at {pos - 1}")
+    return out

@@ -1,0 +1,297 @@
+"""CRDT engine convergence tests.
+
+Gate for SURVEY.md §7 step 2: two (and three) in-process databases
+exchanging changesets must converge under the LWW + causal-length rules
+(reference semantics: /root/reference/doc/crdts.md:13-23, exercised by
+crates/corro-agent/src/agent/tests.rs).
+"""
+
+import random
+
+import pytest
+
+from corrosion_tpu.crdt import connect
+from corrosion_tpu.types.columns import pack_columns, unpack_columns
+
+SCHEMA = """
+CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;
+CREATE TABLE tests2 (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;
+CREATE TABLE testsblob (id BLOB NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;
+CREATE TABLE wide (id INTEGER NOT NULL PRIMARY KEY, a TEXT, b INTEGER, c REAL) ;
+CREATE TABLE pkonly (id INTEGER NOT NULL PRIMARY KEY) WITHOUT ROWID;
+"""
+
+CHANGE_COLS = '"table", pk, cid, val, col_version, db_version, seq, site_id, cl'
+
+
+def mkdb():
+    conn = connect(":memory:")
+    conn.executescript(SCHEMA)
+    for t in ("tests", "tests2", "testsblob", "wide", "pkonly"):
+        conn.execute(f"SELECT crsql_as_crr('{t}')")
+    return conn
+
+
+def changes_since(conn, db_version=0):
+    return conn.execute(
+        f"SELECT {CHANGE_COLS} FROM crsql_changes WHERE db_version > ?",
+        (db_version,),
+    ).fetchall()
+
+
+def apply_changes(conn, changes):
+    """Merge changes, one local db_version per originating (site, db_version)
+    changeset — what the agent's apply path does (ref: agent/util.rs:1548)."""
+    conn.execute("BEGIN")
+    impacted = 0
+    last = 0
+    prev_group = None
+    for ch in changes:
+        group = (ch[7], ch[5])  # (site_id, origin db_version)
+        if prev_group is not None and group != prev_group:
+            conn.execute("SELECT crsql_next_db_version(crsql_next_db_version() + 1)")
+        prev_group = group
+        conn.execute(
+            f"INSERT INTO crsql_changes ({CHANGE_COLS}) VALUES (?,?,?,?,?,?,?,?,?)",
+            ch,
+        )
+        cur = conn.execute("SELECT crsql_rows_impacted()").fetchone()[0]
+        if cur > last:
+            impacted += 1
+        last = cur
+    conn.execute("COMMIT")
+    return impacted
+
+
+def table_dump(conn, table):
+    return sorted(conn.execute(f"SELECT * FROM {table}").fetchall())
+
+
+def sync_once(a, b):
+    """Full bidirectional exchange of all changes."""
+    apply_changes(b, changes_since(a))
+    apply_changes(a, changes_since(b))
+
+
+def assert_converged(conns, tables=("tests", "tests2", "testsblob", "wide", "pkonly")):
+    for t in tables:
+        dumps = [table_dump(c, t) for c in conns]
+        for d in dumps[1:]:
+            assert d == dumps[0], f"{t} diverged: {dumps}"
+
+
+def test_basic_replication():
+    a, b = mkdb(), mkdb()
+    a.execute("INSERT INTO tests (id, text) VALUES (1, 'hello')")
+    ch = changes_since(a)
+    assert len(ch) == 1
+    assert ch[0][0] == "tests" and ch[0][2] == "text" and ch[0][8] == 1
+    impacted = apply_changes(b, ch)
+    assert impacted == 1
+    assert table_dump(b, "tests") == [(1, "hello")]
+    # idempotent: re-applying the same change impacts nothing
+    assert apply_changes(b, ch) == 0
+
+
+def test_lww_biggest_col_version_wins():
+    a, b = mkdb(), mkdb()
+    a.execute("INSERT INTO tests (id, text) VALUES (1, 'v1')")
+    sync_once(a, b)
+    # b updates twice (col_version 3), a updates once (col_version 2)
+    b.execute("UPDATE tests SET text = 'b1' WHERE id = 1")
+    b.execute("UPDATE tests SET text = 'b2' WHERE id = 1")
+    a.execute("UPDATE tests SET text = 'a1' WHERE id = 1")
+    sync_once(a, b)
+    assert_converged([a, b])
+    assert table_dump(a, "tests") == [(1, "b2")]
+
+
+def test_tie_broken_by_biggest_value():
+    a, b = mkdb(), mkdb()
+    a.execute("INSERT INTO tests (id, text) VALUES (1, 'zebra')")
+    b.execute("INSERT INTO tests (id, text) VALUES (1, 'apple')")
+    sync_once(a, b)
+    sync_once(a, b)
+    assert_converged([a, b])
+    assert table_dump(a, "tests") == [(1, "zebra")]
+
+
+def test_concurrent_different_columns_merge():
+    a, b = mkdb(), mkdb()
+    a.execute("INSERT INTO wide (id, a, b, c) VALUES (1, 'x', 1, 1.5)")
+    sync_once(a, b)
+    a.execute("UPDATE wide SET a = 'from_a' WHERE id = 1")
+    b.execute("UPDATE wide SET b = 99 WHERE id = 1")
+    sync_once(a, b)
+    assert_converged([a, b])
+    assert table_dump(a, "wide") == [(1, "from_a", 99, 1.5)]
+
+
+def test_delete_wins_over_concurrent_update():
+    """Delete bumps causal length; a concurrent same-incarnation update loses."""
+    a, b = mkdb(), mkdb()
+    a.execute("INSERT INTO tests (id, text) VALUES (1, 'v1')")
+    sync_once(a, b)
+    a.execute("DELETE FROM tests WHERE id = 1")
+    b.execute("UPDATE tests SET text = 'concurrent' WHERE id = 1")
+    sync_once(a, b)
+    sync_once(a, b)
+    assert_converged([a, b])
+    assert table_dump(a, "tests") == []
+
+
+def test_resurrect_after_delete():
+    a, b = mkdb(), mkdb()
+    a.execute("INSERT INTO tests (id, text) VALUES (1, 'v1')")
+    sync_once(a, b)
+    a.execute("DELETE FROM tests WHERE id = 1")
+    sync_once(a, b)
+    assert table_dump(b, "tests") == []
+    b.execute("INSERT INTO tests (id, text) VALUES (1, 'reborn')")
+    sync_once(a, b)
+    assert_converged([a, b])
+    assert table_dump(a, "tests") == [(1, "reborn")]
+
+
+def test_pk_only_table():
+    a, b = mkdb(), mkdb()
+    a.execute("INSERT INTO pkonly (id) VALUES (7)")
+    ch = changes_since(a)
+    assert len(ch) == 1 and ch[0][2] == "-1" and ch[0][8] == 1
+    apply_changes(b, ch)
+    assert table_dump(b, "pkonly") == [(7,)]
+
+
+def test_blob_pk_roundtrip():
+    a, b = mkdb(), mkdb()
+    a.execute("INSERT INTO testsblob (id, text) VALUES (X'DEADBEEF', 'blobby')")
+    sync_once(a, b)
+    assert table_dump(b, "testsblob") == [(b"\xde\xad\xbe\xef", "blobby")]
+
+
+def test_pack_columns_python_matches_engine():
+    a = mkdb()
+    a.execute("INSERT INTO tests (id, text) VALUES (42, 'x')")
+    (pk_blob,) = a.execute(
+        "SELECT pk FROM crsql_changes WHERE \"table\" = 'tests'"
+    ).fetchone()
+    assert pk_blob == pack_columns([42])
+    assert unpack_columns(pk_blob) == [42]
+    # engine-side pack function agrees for mixed types
+    (blob,) = a.execute(
+        "SELECT crsql_pack_columns(NULL, 5, 1.5, 'txt', X'AB')"
+    ).fetchone()
+    assert unpack_columns(blob) == [None, 5, 1.5, "txt", b"\xab"]
+    assert blob == pack_columns([None, 5, 1.5, "txt", b"\xab"])
+
+
+def test_transitive_sync_through_third_node():
+    """B merges A's changes, then serves them to C with A's attribution."""
+    a, b, c = mkdb(), mkdb(), mkdb()
+    a.execute("INSERT INTO tests (id, text) VALUES (1, 'origin_a')")
+    apply_changes(b, changes_since(a))
+    # C has never talked to A; gets A's rows via B
+    apply_changes(c, changes_since(b))
+    assert table_dump(c, "tests") == [(1, "origin_a")]
+    # attribution: the change row on c carries a's site id
+    a_site = a.execute("SELECT crsql_site_id()").fetchone()[0]
+    sites = [r[7] for r in changes_since(c)]
+    assert sites == [a_site]
+
+
+def test_per_actor_addressing_site_and_db_version():
+    """(site_id, db_version) addresses one changeset — the sync server's query
+    pattern (ref: corro-types/src/pubsub.rs:2882)."""
+    a, b = mkdb(), mkdb()
+    a.execute("INSERT INTO tests (id, text) VALUES (1, 'one')")
+    a.execute("INSERT INTO tests (id, text) VALUES (2, 'two')")
+    apply_changes(b, changes_since(a))
+    a_site = a.execute("SELECT crsql_site_id()").fetchone()[0]
+    rows = b.execute(
+        f"SELECT {CHANGE_COLS} FROM crsql_changes WHERE site_id = ? ORDER BY db_version, seq",
+        (a_site,),
+    ).fetchall()
+    assert len(rows) == 2
+    # distinct local db_versions per originating changeset
+    assert rows[0][5] != rows[1][5]
+
+
+def test_batched_apply_distinct_db_versions():
+    """Batched applies bump the local version per changeset via
+    crsql_next_db_version(n) (ref: agent/util.rs:1548-1551)."""
+    a, b = mkdb(), mkdb()
+    a.execute("INSERT INTO tests (id, text) VALUES (1, 'one')")
+    a.execute("INSERT INTO tests (id, text) VALUES (2, 'two')")
+    groups = {}
+    for ch in changes_since(a):
+        groups.setdefault(ch[5], []).append(ch)
+    b.execute("BEGIN")
+    versions = []
+    for _, chs in sorted(groups.items()):
+        b.execute("SELECT crsql_next_db_version(crsql_next_db_version() + 1)")
+        for ch in chs:
+            b.execute(
+                f"INSERT INTO crsql_changes ({CHANGE_COLS}) VALUES (?,?,?,?,?,?,?,?,?)",
+                ch,
+            )
+        versions.append(b.execute("SELECT crsql_next_db_version()").fetchone()[0])
+    b.execute("COMMIT")
+    assert len(set(versions)) == 2
+    assert table_dump(b, "tests") == [(1, "one"), (2, "two")]
+
+
+def test_rows_impacted_cumulative_and_noop_for_equal():
+    a, b = mkdb(), mkdb()
+    a.execute("INSERT INTO tests (id, text) VALUES (1, 'same')")
+    b.execute("INSERT INTO tests (id, text) VALUES (1, 'same')")
+    # identical value+version on both sides: merge is a no-op
+    assert apply_changes(b, changes_since(a)) == 0
+
+
+def test_randomized_convergence_three_nodes():
+    """Random ops on 3 nodes + random gossip exchanges must converge."""
+    rng = random.Random(7)
+    nodes = [mkdb() for _ in range(3)]
+    for step in range(120):
+        n = rng.choice(nodes)
+        op = rng.random()
+        rid = rng.randrange(5)
+        if op < 0.5:
+            n.execute(
+                "INSERT INTO tests (id, text) VALUES (?, ?) "
+                "ON CONFLICT (id) DO UPDATE SET text = excluded.text",
+                (rid, f"s{step}"),
+            )
+        elif op < 0.7:
+            n.execute("DELETE FROM tests WHERE id = ?", (rid,))
+        else:
+            n.execute(
+                "INSERT INTO wide (id, a, b, c) VALUES (?, ?, ?, ?) "
+                "ON CONFLICT (id) DO UPDATE SET a = excluded.a, b = excluded.b",
+                (rid, f"a{step}", step, step / 2),
+            )
+        if rng.random() < 0.3:
+            x, y = rng.sample(range(3), 2)
+            apply_changes(nodes[y], changes_since(nodes[x]))
+    # full mesh exchange until quiescent
+    for _ in range(4):
+        for x in range(3):
+            for y in range(3):
+                if x != y:
+                    apply_changes(nodes[y], changes_since(nodes[x]))
+    assert_converged(nodes)
+
+
+def test_schema_alter_add_column():
+    a = mkdb()
+    a.execute("INSERT INTO tests (id, text) VALUES (1, 'pre')")
+    a.execute("SELECT crsql_begin_alter('tests')")
+    a.execute("ALTER TABLE tests ADD COLUMN extra TEXT DEFAULT ''")
+    a.execute("SELECT crsql_commit_alter('tests')")
+    a.execute("UPDATE tests SET extra = 'post' WHERE id = 1")
+    b = mkdb()
+    b.execute("SELECT crsql_begin_alter('tests')")
+    b.execute("ALTER TABLE tests ADD COLUMN extra TEXT DEFAULT ''")
+    b.execute("SELECT crsql_commit_alter('tests')")
+    apply_changes(b, changes_since(a))
+    assert table_dump(b, "tests") == [(1, "pre", "post")]

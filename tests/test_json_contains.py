@@ -1,0 +1,33 @@
+"""corro_json_contains — port of the reference's test vectors
+(crates/sqlite-functions/src/lib.rs:53-127)."""
+
+from corrosion_tpu.crdt import connect
+
+
+def q(conn, a, b):
+    return bool(conn.execute("SELECT corro_json_contains(?, ?)", (a, b)).fetchone()[0])
+
+
+def test_corro_json_contains():
+    conn = connect(":memory:", load_crdt=False)
+    assert q(conn, "{}", "{}")
+    assert q(conn, "{}", '{"key": "value"}')
+    assert not q(conn, '{"key": "value"}', "{}")
+    assert q(conn, '{"key": "value"}', '{"key": "value"}')
+    assert q(conn, '{"key": "value"}', '{"key": "value", "key2": "value2"}')
+    assert not q(conn, '{"key": "value"}', '{"key": "wrong value"}')
+    assert q(
+        conn,
+        '{"metadata": { "key": "value"} }',
+        '{"metadata": { "key": "value"} }',
+    )
+    assert not q(
+        conn,
+        '{"metadata": { "key": "value"} }',
+        '{"metadata": { "key": "wrong value"} }',
+    )
+    # arrays compare by equality (not element containment)
+    assert q(conn, "[1, 2]", "[1, 2]")
+    assert not q(conn, "[1]", "[1, 2]")
+    # malformed json is just false
+    assert not q(conn, "{", "{}")

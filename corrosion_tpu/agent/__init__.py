@@ -1,0 +1,23 @@
+"""Per-node agent runtime: bookkeeping, write pipeline, change application.
+
+Equivalent of crates/corro-agent (the agent state + apply path layers; the
+network loops live in corrosion_tpu.swim / .broadcast / .sync).
+"""
+
+from .agent import (  # noqa: F401
+    Agent,
+    AgentConfig,
+    ExecResult,
+    TransactionOutcome,
+    make_broadcastable_changes,
+)
+from .bookkeeping import (  # noqa: F401
+    Booked,
+    BookedVersions,
+    Bookie,
+    Cleared,
+    Current,
+    LockRegistry,
+    Partial,
+)
+from .pool import PRIORITY_HIGH, PRIORITY_LOW, PRIORITY_NORMAL, SplitPool  # noqa: F401

@@ -1,0 +1,313 @@
+"""The per-node Agent: shared handle over store, bookkeeping, clock, members.
+
+Equivalent of crates/corro-types/src/agent.rs:50-246 (``Agent``) plus the
+setup path (crates/corro-agent/src/agent/setup.rs): open the CRDT store,
+migrate bookkeeping, load per-actor ledgers, and expose the apply/generate
+operations the runtime loops drive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sqlite3
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..types.actor import ActorId
+from ..types.broadcast import ChangeV1, ChangesetFull
+from ..types.clock import HLC
+from ..types.ranges import RangeSet
+from ..types.sync_state import SyncStateV1
+from . import apply as apply_mod
+from .bookkeeping import (
+    Booked,
+    BookedVersions,
+    Bookie,
+    Cleared,
+    Current,
+    LockRegistry,
+    Partial,
+)
+from .migrations import migrate
+from .pool import PRIORITY_HIGH, SplitPool
+
+
+@dataclass
+class AgentConfig:
+    db_path: str = ":memory:"
+    actor_id: Optional[ActorId] = None
+    read_conns: int = 4
+
+
+class Agent:
+    """One node's state handle (ref: agent.rs Agent + setup.rs setup())."""
+
+    def __init__(self, config: AgentConfig) -> None:
+        self.config = config
+        self.pool = SplitPool(config.db_path, read_conns=config.read_conns)
+        self.clock = HLC()
+        self.registry = LockRegistry()
+        self.bookie = Bookie(self.registry)
+        self.actor_id: ActorId = ActorId.zero()  # set in open()
+        self._opened = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    def open_sync(self) -> "Agent":
+        """Blocking open: load engine, migrate, restore bookkeeping
+        (ref: setup.rs:51-133 + run_root.rs:131-187 Bookie init)."""
+        if self._opened:
+            return self
+        self.pool.open()
+        conn = self.pool._write_conn
+        assert conn is not None
+        migrate(conn)
+        site = conn.execute("SELECT crsql_site_id()").fetchone()[0]
+        self.actor_id = ActorId(bytes(site))
+        self._restore_bookkeeping(conn)
+        self._opened = True
+        return self
+
+    def close(self) -> None:
+        self.pool.close()
+        self._opened = False
+
+    def _restore_bookkeeping(self, conn: sqlite3.Connection) -> None:
+        """Reload BookedVersions per actor (ref: BookedVersions::from_conn,
+        agent.rs:1023-1077)."""
+        rows = conn.execute(
+            "SELECT actor_id, start_version, end_version, db_version, "
+            "last_seq, ts FROM __corro_bookkeeping"
+        ).fetchall()
+        for actor_blob, start_v, end_v, db_v, last_seq, ts in rows:
+            actor = ActorId(bytes(actor_blob))
+            book = self.bookie.ensure(actor).versions
+            if db_v is None:
+                book.insert_many((start_v, end_v or start_v), Cleared())
+            else:
+                book.insert_many(
+                    (start_v, end_v or start_v),
+                    Current(db_version=db_v, last_seq=last_seq, ts=ts or 0),
+                )
+        rows = conn.execute(
+            "SELECT site_id, version, start_seq, end_seq, last_seq, ts FROM "
+            "__corro_seq_bookkeeping"
+        ).fetchall()
+        for site_blob, version, s, e, last_seq, ts in rows:
+            actor = ActorId(bytes(site_blob))
+            book = self.bookie.ensure(actor).versions
+            if book.contains_version(version):
+                continue  # already Current/Cleared; stale seq rows
+            seqs = RangeSet([(s, e)])
+            book.insert_many(
+                (version, version),
+                Partial(seqs=seqs, last_seq=last_seq, ts=int(ts)),
+            )
+
+    # -- change application ------------------------------------------------
+
+    async def process_multiple_changes(
+        self, changes: Iterable[ChangeV1]
+    ) -> apply_mod.ApplyResult:
+        """Batch-apply incoming changesets (ref: util.rs:1128-1389): acquire
+        per-actor booked write locks in deterministic order, run one write
+        transaction, fold results into the in-memory ledgers, then flush any
+        partials that became gap-free."""
+        changes = list(changes)
+        actor_ids = sorted({c.actor_id for c in changes})
+        books: Dict[ActorId, Booked] = {
+            a: self.bookie.ensure(a) for a in actor_ids
+        }
+        # lock in sorted order to avoid lock-order inversion; track what we
+        # actually hold so cancellation mid-acquisition can't leak a lock
+        held: List[ActorId] = []
+        try:
+            for a in actor_ids:
+                await books[a]._lock.acquire_write(
+                    f"process_multiple_changes(booked writer):{a.as_simple()}"
+                )
+                held.append(a)
+            result = await self.pool.write_call(
+                lambda conn: apply_mod.process_changes_tx(
+                    conn, {a: books[a].versions for a in actor_ids}, changes
+                )
+            )
+            for actor, knowns in result.knowns.items():
+                for versions, known in knowns:
+                    books[actor].versions.insert_many(versions, known)
+            for actor, version in result.ready_to_flush:
+                current = await self.pool.write_call(
+                    lambda conn, a=actor, v=version: _flush_tx(conn, a, v)
+                )
+                if current is not None:
+                    books[actor].versions.insert_many(
+                        (version, version), current
+                    )
+        finally:
+            for a in held:
+                await books[a]._lock.release_write()
+                self.registry.unregister(
+                    f"process_multiple_changes(booked writer):{a.as_simple()}"
+                )
+        return result
+
+    # -- sync state --------------------------------------------------------
+
+    def generate_sync(self) -> SyncStateV1:
+        """Summarize what we have/need per actor (ref: sync.rs:278-325)."""
+        state = SyncStateV1(actor_id=self.actor_id)
+        for actor_id, booked in self.bookie.items():
+            bv = booked.versions
+            last = bv.last()
+            if last is None:
+                continue
+            need = [(s, e) for s, e in bv.sync_need()]
+            if need:
+                state.need[actor_id] = need
+            for v, partial in bv.partials.items():
+                state.partial_need.setdefault(actor_id, {})[v] = list(
+                    partial.gaps()
+                )
+            state.heads[actor_id] = last
+        return state
+
+
+@dataclass
+class ExecResult:
+    """Per-statement outcome (ref: corro-api-types ExecResponse/ExecResult)."""
+
+    rows_affected: int = 0
+    error: Optional[str] = None
+
+
+@dataclass
+class TransactionOutcome:
+    results: List[ExecResult]
+    version: Optional[int]  # None when nothing impactful changed
+    db_version: Optional[int]
+    last_seq: Optional[int]
+    ts: int
+    changesets: List[ChangeV1] = field(default_factory=list)
+
+
+async def make_broadcastable_changes(
+    agent: Agent, statements: List[Tuple[str, Tuple]]
+) -> TransactionOutcome:
+    """Run client statements in one tx and produce broadcastable changesets
+    (ref: api/public/mod.rs:39-242).
+
+    Holds our own actor's booked write lock across the write so version
+    allocation is serialized, then reads the committed ``crsql_changes`` rows
+    back and chunks them (8 KiB budget) into ChangesetFull messages.
+    """
+    from ..types.change import MAX_CHANGES_BYTE_SIZE, Change, ChunkedChanges
+
+    booked = agent.bookie.ensure(agent.actor_id)
+    ts = agent.clock.new_timestamp()
+    async with booked.write(f"transact:{agent.actor_id.as_simple()}"):
+        last = booked.versions.last() or 0
+        version = last + 1
+
+        def _tx(conn: sqlite3.Connection):
+            conn.execute("BEGIN IMMEDIATE")
+            try:
+                results = []
+                for sql, params in statements:
+                    cur = conn.execute(sql, params)
+                    results.append(ExecResult(rows_affected=cur.rowcount))
+                db_version = conn.execute(
+                    "SELECT crsql_next_db_version()"
+                ).fetchone()[0]
+                has_changes = conn.execute(
+                    "SELECT EXISTS(SELECT 1 FROM crsql_changes WHERE "
+                    "db_version = ?)",
+                    (db_version,),
+                ).fetchone()[0]
+                if not has_changes:
+                    conn.execute("COMMIT")
+                    return results, None, None
+                last_seq = conn.execute(
+                    "SELECT MAX(seq) FROM crsql_changes WHERE db_version = ?",
+                    (db_version,),
+                ).fetchone()[0]
+                apply_mod.insert_bookkeeping_current(
+                    conn,
+                    agent.actor_id,
+                    version,
+                    Current(db_version=db_version, last_seq=last_seq, ts=ts),
+                )
+                conn.execute("COMMIT")
+                return results, db_version, last_seq
+            except BaseException:
+                conn.execute("ROLLBACK")
+                raise
+
+        results, db_version, last_seq = await agent.pool.write_call(
+            _tx, priority=PRIORITY_HIGH
+        )
+        if db_version is None:
+            return TransactionOutcome(
+                results=results, version=None, db_version=None, last_seq=None, ts=ts
+            )
+        booked.versions.insert_many(
+            (version, version),
+            Current(db_version=db_version, last_seq=last_seq, ts=ts),
+        )
+
+    # read back committed rows and chunk for broadcast (mod.rs:178-226)
+    def _read(conn: sqlite3.Connection):
+        return conn.execute(
+            f"SELECT {apply_mod.CHANGE_COLS} FROM crsql_changes WHERE "
+            "db_version = ? ORDER BY seq",
+            (db_version,),
+        ).fetchall()
+
+    rows = await agent.pool.read_call(_read)
+    changes = [
+        Change(
+            table=r[0],
+            pk=bytes(r[1]),
+            cid=r[2],
+            val=r[3],
+            col_version=r[4],
+            db_version=r[5],
+            seq=r[6],
+            site_id=bytes(r[7]),
+            cl=r[8],
+        )
+        for r in rows
+    ]
+    changesets = [
+        ChangeV1(
+            actor_id=agent.actor_id,
+            changeset=ChangesetFull(
+                version=version,
+                changes=tuple(chunk),
+                seqs=seq_range,
+                last_seq=last_seq,
+                ts=ts,
+            ),
+        )
+        for chunk, seq_range in ChunkedChanges(
+            changes, 0, last_seq, MAX_CHANGES_BYTE_SIZE
+        )
+    ]
+    return TransactionOutcome(
+        results=results,
+        version=version,
+        db_version=db_version,
+        last_seq=last_seq,
+        ts=ts,
+        changesets=changesets,
+    )
+
+
+def _flush_tx(conn: sqlite3.Connection, actor: ActorId, version: int):
+    conn.execute("BEGIN IMMEDIATE")
+    try:
+        current = apply_mod.process_fully_buffered_changes(conn, actor, version)
+        conn.execute("COMMIT")
+        return current
+    except BaseException:
+        conn.execute("ROLLBACK")
+        raise

@@ -1,0 +1,338 @@
+"""Change application pipeline: complete, partial, buffered, empty.
+
+Equivalent of crates/corro-agent/src/agent/util.rs — the functions that take
+incoming changesets and land them in the CRDT store + bookkeeping:
+
+- ``process_multiple_changes``   (util.rs:1128-1389) — batch apply in one tx
+- ``process_complete_version``   (util.rs:1514-1621) — full version → merge
+  into ``crsql_changes``, keep only impactful rows
+- ``process_incomplete_version`` (util.rs:1392-1511) — partial chunk →
+  ``__corro_buffered_changes`` + seq-range bookkeeping
+- ``process_fully_buffered_changes`` (util.rs:986-1125) — gap-free partial →
+  flush buffer into ``crsql_changes``
+- ``store_empty_changeset``      (util.rs:907-983) — record cleared versions,
+  merging adjacent cleared ranges
+
+The sync functions operate on the (single) write connection inside one
+transaction; the async orchestrator in handlers.py drives them through the
+SplitPool write permit.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..types.actor import ActorId
+from ..types.broadcast import (
+    ChangeV1,
+    Changeset,
+    ChangesetEmpty,
+    ChangesetFull,
+)
+from ..types.change import Change
+from ..types.ranges import RangeSet
+from .bookkeeping import (
+    CLEARED,
+    BookedVersions,
+    Cleared,
+    Current,
+    KnownDbVersion,
+    Partial,
+)
+
+CHANGE_COLS = '"table", pk, cid, val, col_version, db_version, seq, site_id, cl'
+
+
+def store_empty_changeset(
+    conn: sqlite3.Connection, actor_id: ActorId, versions: Tuple[int, int]
+) -> None:
+    """Record [start, end] as cleared for actor, coalescing with adjacent
+    cleared ranges and deleting covered Current rows (ref: util.rs:907-983)."""
+    start, end = versions
+    # merge with overlapping-or-adjacent cleared (db_version IS NULL) ranges
+    rows = conn.execute(
+        "SELECT start_version, COALESCE(end_version, start_version) "
+        "FROM __corro_bookkeeping WHERE actor_id = ? AND db_version IS NULL "
+        "AND COALESCE(end_version, start_version) >= ? AND start_version <= ?",
+        (actor_id, start - 1, end + 1),
+    ).fetchall()
+    for s, e in rows:
+        start = min(start, s)
+        end = max(end, e)
+    conn.execute(
+        "DELETE FROM __corro_bookkeeping WHERE actor_id = ? AND db_version IS "
+        "NULL AND start_version >= ? AND start_version <= ?",
+        (actor_id, start, end + 1),
+    )
+    # drop applied single-version rows now covered by the cleared range
+    conn.execute(
+        "DELETE FROM __corro_bookkeeping WHERE actor_id = ? AND db_version IS "
+        "NOT NULL AND start_version >= ? AND start_version <= ?",
+        (actor_id, start, end),
+    )
+    conn.execute(
+        "INSERT INTO __corro_bookkeeping (actor_id, start_version, "
+        "end_version, db_version, last_seq, ts) VALUES (?, ?, ?, NULL, NULL, NULL)",
+        (actor_id, start, end),
+    )
+
+
+def clear_buffered_meta(
+    conn: sqlite3.Connection, actor_id: ActorId, versions: Tuple[int, int]
+) -> None:
+    """Drop buffered chunks + seq bookkeeping for versions that just became
+    Current/Cleared via a complete changeset (ref: util.rs:1625-1640)."""
+    conn.execute(
+        "DELETE FROM __corro_buffered_changes WHERE site_id = ? AND version "
+        ">= ? AND version <= ?",
+        (actor_id, versions[0], versions[1]),
+    )
+    conn.execute(
+        "DELETE FROM __corro_seq_bookkeeping WHERE site_id = ? AND version "
+        ">= ? AND version <= ?",
+        (actor_id, versions[0], versions[1]),
+    )
+
+
+def insert_bookkeeping_current(
+    conn: sqlite3.Connection,
+    actor_id: ActorId,
+    version: int,
+    current: Current,
+) -> None:
+    conn.execute(
+        "INSERT OR REPLACE INTO __corro_bookkeeping (actor_id, start_version, "
+        "end_version, db_version, last_seq, ts) VALUES (?, ?, NULL, ?, ?, ?)",
+        (actor_id, version, current.db_version, current.last_seq, current.ts),
+    )
+
+
+def bump_db_version(conn: sqlite3.Connection) -> None:
+    """Give the next changeset in this tx its own local db version
+    (ref: the manual bump in util.rs:1548-1551)."""
+    conn.execute("SELECT crsql_next_db_version(crsql_next_db_version() + 1)")
+
+
+def process_complete_version(
+    conn: sqlite3.Connection,
+    actor_id: ActorId,
+    changeset: ChangesetFull,
+) -> Tuple[KnownDbVersion, Changeset]:
+    """Merge a complete version's changes; returns the resulting known state
+    and the impactful changeset to rebroadcast (ref: util.rs:1514-1621)."""
+    bump_db_version(conn)
+    impactful: List[Change] = []
+    last_impacted = conn.execute("SELECT crsql_rows_impacted()").fetchone()[0]
+    ins = (
+        f"INSERT INTO crsql_changes ({CHANGE_COLS}) VALUES (?,?,?,?,?,?,?,?,?)"
+    )
+    for ch in changeset.changes:
+        conn.execute(
+            ins,
+            (
+                ch.table,
+                ch.pk,
+                ch.cid,
+                ch.val,
+                ch.col_version,
+                ch.db_version,
+                ch.seq,
+                ch.site_id,
+                ch.cl,
+            ),
+        )
+        impacted = conn.execute("SELECT crsql_rows_impacted()").fetchone()[0]
+        if impacted > last_impacted:
+            impactful.append(ch)
+        last_impacted = impacted
+
+    if not impactful:
+        return CLEARED, ChangesetEmpty(versions=changeset.versions, ts=changeset.ts)
+
+    db_version = conn.execute("SELECT crsql_next_db_version()").fetchone()[0]
+    known = Current(
+        db_version=db_version, last_seq=changeset.last_seq, ts=changeset.ts
+    )
+    new_changeset = ChangesetFull(
+        version=changeset.version,
+        changes=tuple(impactful),
+        seqs=changeset.seqs,
+        last_seq=changeset.last_seq,
+        ts=changeset.ts,
+    )
+    return known, new_changeset
+
+
+def process_incomplete_version(
+    conn: sqlite3.Connection,
+    actor_id: ActorId,
+    changeset: ChangesetFull,
+) -> Partial:
+    """Buffer a partial chunk + merge its seq range into bookkeeping
+    (ref: util.rs:1392-1511)."""
+    version = changeset.version
+    ins = (
+        'INSERT OR IGNORE INTO __corro_buffered_changes ("table", pk, cid, '
+        "val, col_version, db_version, site_id, seq, cl, version) VALUES "
+        "(?,?,?,?,?,?,?,?,?,?)"
+    )
+    for ch in changeset.changes:
+        conn.execute(
+            ins,
+            (
+                ch.table,
+                ch.pk,
+                ch.cid,
+                ch.val,
+                ch.col_version,
+                ch.db_version,
+                ch.site_id,
+                ch.seq,
+                ch.cl,
+                version,
+            ),
+        )
+
+    # merge the covered seq range into __corro_seq_bookkeeping
+    seqs = RangeSet()
+    rows = conn.execute(
+        "SELECT start_seq, end_seq FROM __corro_seq_bookkeeping WHERE site_id "
+        "= ? AND version = ?",
+        (actor_id, version),
+    ).fetchall()
+    for s, e in rows:
+        seqs.insert(s, e)
+    seqs.insert(*changeset.seqs)
+    conn.execute(
+        "DELETE FROM __corro_seq_bookkeeping WHERE site_id = ? AND version = ?",
+        (actor_id, version),
+    )
+    for s, e in seqs:
+        conn.execute(
+            "INSERT INTO __corro_seq_bookkeeping (site_id, version, start_seq, "
+            "end_seq, last_seq, ts) VALUES (?,?,?,?,?,?)",
+            (actor_id, version, s, e, changeset.last_seq, changeset.ts),
+        )
+    return Partial(seqs=seqs, last_seq=changeset.last_seq, ts=changeset.ts)
+
+
+def process_fully_buffered_changes(
+    conn: sqlite3.Connection,
+    actor_id: ActorId,
+    version: int,
+) -> Optional[Current]:
+    """If version's buffered seqs are gap-free, flush them into
+    ``crsql_changes`` and clean up (ref: util.rs:986-1125).  Returns the new
+    Current on success, None when still incomplete.  Caller wraps in a tx and
+    holds the actor's booked write lock."""
+    rows = conn.execute(
+        "SELECT start_seq, end_seq, last_seq, ts FROM __corro_seq_bookkeeping "
+        "WHERE site_id = ? AND version = ?",
+        (actor_id, version),
+    ).fetchall()
+    if not rows:
+        return None
+    seqs = RangeSet()
+    last_seq = rows[0][2]
+    ts = rows[0][3]
+    for s, e, _ls, _ts in rows:
+        seqs.insert(s, e)
+    if not seqs.contains_range(0, last_seq):
+        return None
+
+    bump_db_version(conn)
+    conn.execute(
+        f"INSERT INTO crsql_changes ({CHANGE_COLS}) "
+        'SELECT "table", pk, cid, val, col_version, db_version, seq, site_id, '
+        "cl FROM __corro_buffered_changes WHERE site_id = ? AND version = ? "
+        "ORDER BY seq",
+        (actor_id, version),
+    )
+    conn.execute(
+        "DELETE FROM __corro_buffered_changes WHERE site_id = ? AND version = ?",
+        (actor_id, version),
+    )
+    conn.execute(
+        "DELETE FROM __corro_seq_bookkeeping WHERE site_id = ? AND version = ?",
+        (actor_id, version),
+    )
+    db_version = conn.execute("SELECT crsql_next_db_version()").fetchone()[0]
+    current = Current(db_version=db_version, last_seq=last_seq, ts=ts)
+    insert_bookkeeping_current(conn, actor_id, version, current)
+    return current
+
+
+@dataclass
+class ApplyResult:
+    """Outcome of one batch apply."""
+
+    # changesets that changed state here and should be rebroadcast/notified
+    applied: List[Tuple[ActorId, Changeset]]
+    # per-actor known-version updates to fold into the in-memory bookkeeping
+    knowns: Dict[ActorId, List[Tuple[Tuple[int, int], KnownDbVersion]]]
+    # partial versions that became gap-free and are ready to flush
+    ready_to_flush: List[Tuple[ActorId, int]]
+
+
+def process_changes_tx(
+    conn: sqlite3.Connection,
+    books: Dict[ActorId, BookedVersions],
+    changes: Iterable[ChangeV1],
+) -> ApplyResult:
+    """Apply a batch of changesets in ONE transaction (the write side of
+    process_multiple_changes, util.rs:1128-1389).
+
+    ``books`` are the in-memory ledgers of every actor involved; the caller
+    must hold their write locks and fold the returned knowns back in after
+    commit.
+    """
+    result = ApplyResult(applied=[], knowns={}, ready_to_flush=[])
+    conn.execute("BEGIN IMMEDIATE")
+    try:
+        for change in changes:
+            actor_id = change.actor_id
+            cs = change.changeset
+            book = books[actor_id]
+            versions = cs.versions
+
+            if isinstance(cs, ChangesetEmpty):
+                if book.contains_all(versions, None):
+                    continue
+                store_empty_changeset(conn, actor_id, versions)
+                clear_buffered_meta(conn, actor_id, versions)
+                result.knowns.setdefault(actor_id, []).append((versions, CLEARED))
+                result.applied.append((actor_id, cs))
+                continue
+
+            assert isinstance(cs, ChangesetFull)
+            seqs = cs.seqs
+            if book.contains_all(versions, seqs):
+                continue  # already have it
+
+            if cs.is_complete():
+                known, new_cs = process_complete_version(conn, actor_id, cs)
+                if isinstance(known, Cleared):
+                    store_empty_changeset(conn, actor_id, versions)
+                else:
+                    insert_bookkeeping_current(
+                        conn, actor_id, cs.version, known
+                    )
+                # purge any stale partial buffering for this version so a
+                # restart can't resurrect a phantom Partial next to the
+                # Current (ref: check_buffered_meta_to_clear + the clear
+                # loop, util.rs:1625-1640)
+                clear_buffered_meta(conn, actor_id, versions)
+                result.knowns.setdefault(actor_id, []).append((versions, known))
+                result.applied.append((actor_id, new_cs))
+            else:
+                partial = process_incomplete_version(conn, actor_id, cs)
+                result.knowns.setdefault(actor_id, []).append((versions, partial))
+                if partial.is_complete():
+                    result.ready_to_flush.append((actor_id, cs.version))
+        conn.execute("COMMIT")
+    except BaseException:
+        conn.execute("ROLLBACK")
+        raise
+    return result

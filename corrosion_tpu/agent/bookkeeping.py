@@ -1,0 +1,293 @@
+"""Version bookkeeping: which versions of which actor this node has.
+
+Equivalent of crates/corro-types/src/agent.rs:965-1215 (``KnownDbVersion``,
+``BookedVersions``, ``Booked``, ``Bookie``) and the ``LockRegistry``
+(agent.rs:787-962) — the labeled-lock contention debugger surfaced by the
+admin API (`locks --top N`).
+
+Every version of an actor is in exactly one state:
+- ``Cleared``  — applied and since compacted (or empty);
+- ``Current``  — applied; maps to a local crsql db_version;
+- ``Partial``  — some seq ranges buffered, not yet applied.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional, Tuple
+
+from ..types.actor import ActorId
+from ..types.ranges import RangeSet
+
+
+@dataclass(frozen=True)
+class Cleared:
+    pass
+
+
+@dataclass(frozen=True)
+class Current:
+    db_version: int
+    last_seq: int
+    ts: int
+
+
+@dataclass
+class Partial:
+    seqs: RangeSet
+    last_seq: int
+    ts: int
+
+    def is_complete(self) -> bool:
+        return self.seqs.contains_range(0, self.last_seq)
+
+    def gaps(self) -> Iterator[Tuple[int, int]]:
+        return self.seqs.gaps(0, self.last_seq)
+
+
+KnownDbVersion = Cleared | Current | Partial
+CLEARED = Cleared()
+
+
+class BookedVersions:
+    """Per-actor version ledger (ref: agent.rs:1013-1187)."""
+
+    def __init__(self) -> None:
+        self.cleared = RangeSet()
+        self.current: Dict[int, Current] = {}
+        self.partials: Dict[int, Partial] = {}
+        self._sync_need = RangeSet()
+        self._last: Optional[int] = None
+
+    # -- queries ----------------------------------------------------------
+
+    def contains_version(self, version: int) -> bool:
+        return (
+            self.cleared.contains(version)
+            or version in self.current
+            or version in self.partials
+        )
+
+    def get(self, version: int) -> Optional[KnownDbVersion]:
+        if self.cleared.contains(version):
+            return CLEARED
+        got = self.current.get(version)
+        if got is not None:
+            return got
+        return self.partials.get(version)
+
+    def contains(self, version: int, seqs: Optional[Tuple[int, int]]) -> bool:
+        known = self.get(version)
+        if known is None:
+            return False
+        if seqs is None or not isinstance(known, Partial):
+            return True
+        return known.seqs.contains_range(*seqs)
+
+    def contains_all(
+        self, versions: Tuple[int, int], seqs: Optional[Tuple[int, int]]
+    ) -> bool:
+        return all(
+            self.contains(v, seqs) for v in range(versions[0], versions[1] + 1)
+        )
+
+    def contains_current(self, version: int) -> bool:
+        return version in self.current
+
+    def current_versions(self) -> Dict[int, int]:
+        """db_version -> version map (ref: agent.rs:1120-1125)."""
+        return {cur.db_version: v for v, cur in self.current.items()}
+
+    def last(self) -> Optional[int]:
+        return self._last
+
+    def sync_need(self) -> RangeSet:
+        return self._sync_need
+
+    # -- mutation ---------------------------------------------------------
+
+    def insert(self, version: int, known: KnownDbVersion) -> Optional[Partial]:
+        return self.insert_many((version, version), known)
+
+    def insert_many(
+        self, versions: Tuple[int, int], known: KnownDbVersion
+    ) -> Optional[Partial]:
+        """Record a version range in a new state (ref: agent.rs:1133-1181).
+
+        Returns the (merged) Partial when inserting partial state, so the
+        caller can check gap-freeness.
+        """
+        ret: Optional[Partial] = None
+        if isinstance(known, Partial):
+            existing = self.partials.get(versions[0])
+            if existing is None:
+                self.partials[versions[0]] = known
+                ret = known
+            else:
+                existing.seqs.insert_all(known.seqs)
+                existing.last_seq = known.last_seq
+                existing.ts = known.ts
+                ret = existing
+        elif isinstance(known, Current):
+            self.partials.pop(versions[0], None)
+            self.current[versions[0]] = known
+        else:  # Cleared
+            for v in range(versions[0], versions[1] + 1):
+                self.partials.pop(v, None)
+                self.current.pop(v, None)
+            self.cleared.insert(*versions)
+
+        old_last = self._last if self._last is not None else 0
+        self._last = max(versions[1], old_last)
+        if old_last < versions[0]:
+            # everything between our old head and this range is now needed
+            self._sync_need.insert(old_last + 1, versions[0])
+        self._sync_need.remove(*versions)
+        return ret
+
+
+class CountedRwLock:
+    """Async reader-writer lock with labeled acquisition tracking.
+
+    The tracking side is the equivalent of the reference's ``LockRegistry``
+    (agent.rs:787-962): every acquisition is registered with a label and
+    state so in-flight locks can be dumped for deadlock debugging.
+    """
+
+    def __init__(self, registry: "LockRegistry") -> None:
+        self._registry = registry
+        self._cond = asyncio.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    async def acquire_read(self, label: str) -> None:
+        entry = self._registry.register(label, "read")
+        async with self._cond:
+            # write-preferring: new readers queue behind waiting writers so a
+            # steady read stream cannot starve the apply path
+            while self._writer or self._writers_waiting:
+                await self._cond.wait()
+            self._readers += 1
+        entry.state = "locked"
+
+    async def release_read(self) -> None:
+        async with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    async def acquire_write(self, label: str) -> None:
+        entry = self._registry.register(label, "write")
+        async with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    await self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+        entry.state = "locked"
+
+    async def release_write(self) -> None:
+        async with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+    def read(self, label: str) -> "_LockCtx":
+        return _LockCtx(self, label, write=False)
+
+    def write(self, label: str) -> "_LockCtx":
+        return _LockCtx(self, label, write=True)
+
+
+class _LockCtx:
+    def __init__(self, lock: CountedRwLock, label: str, write: bool) -> None:
+        self._lock = lock
+        self._label = label
+        self._write = write
+
+    async def __aenter__(self) -> None:
+        if self._write:
+            await self._lock.acquire_write(self._label)
+        else:
+            await self._lock.acquire_read(self._label)
+
+    async def __aexit__(self, *exc) -> None:
+        if self._write:
+            await self._lock.release_write()
+        else:
+            await self._lock.release_read()
+        self._lock._registry.unregister(self._label)
+
+
+@dataclass
+class LockEntry:
+    label: str
+    kind: str
+    state: str
+    started_at: float
+
+
+class LockRegistry:
+    """In-flight lock tracker (ref: agent.rs LockRegistry + LockMeta)."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[int, LockEntry] = {}
+        self._next_id = 0
+
+    def register(self, label: str, kind: str) -> LockEntry:
+        entry = LockEntry(label=label, kind=kind, state="acquiring", started_at=time.monotonic())
+        self._entries[self._next_id] = entry
+        self._next_id += 1
+        return entry
+
+    def unregister(self, label: str) -> None:
+        for k, e in list(self._entries.items()):
+            if e.label == label:
+                del self._entries[k]
+                break
+
+    def top(self, n: int = 10) -> list[LockEntry]:
+        """Longest-held in-flight locks first (`locks --top`, corro-admin)."""
+        return sorted(self._entries.values(), key=lambda e: e.started_at)[:n]
+
+
+class Booked:
+    """One actor's BookedVersions behind a counted RW lock (ref: agent.rs Booked)."""
+
+    def __init__(self, versions: BookedVersions, registry: LockRegistry) -> None:
+        self.versions = versions
+        self._lock = CountedRwLock(registry)
+
+    def read(self, label: str) -> _LockCtx:
+        return self._lock.read(label)
+
+    def write(self, label: str) -> _LockCtx:
+        return self._lock.write(label)
+
+
+class Bookie:
+    """actor_id -> Booked registry (ref: agent.rs Bookie)."""
+
+    def __init__(self, registry: Optional[LockRegistry] = None) -> None:
+        self.registry = registry if registry is not None else LockRegistry()
+        self._by_actor: Dict[ActorId, Booked] = {}
+
+    def ensure(self, actor_id: ActorId) -> Booked:
+        got = self._by_actor.get(actor_id)
+        if got is None:
+            got = Booked(BookedVersions(), self.registry)
+            self._by_actor[actor_id] = got
+        return got
+
+    def get(self, actor_id: ActorId) -> Optional[Booked]:
+        return self._by_actor.get(actor_id)
+
+    def items(self) -> Iterator[Tuple[ActorId, Booked]]:
+        return iter(list(self._by_actor.items()))
+
+    def __contains__(self, actor_id: ActorId) -> bool:
+        return actor_id in self._by_actor

@@ -1,0 +1,93 @@
+"""Agent bookkeeping schema + migrations.
+
+Equivalent of the migration set in crates/corro-types/src/agent.rs:250-430:
+the ``__corro_*`` tables every node keeps alongside user data.  Table and
+column names match the reference so operational queries port 1:1.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+
+SCHEMA_VERSION = 1
+
+INIT_SQL = """
+-- key/value for internal corrosion data (ref: agent.rs __corro_state)
+CREATE TABLE IF NOT EXISTS __corro_state (key TEXT NOT NULL PRIMARY KEY, value);
+
+-- version bookkeeping: one row per contiguous version range per actor
+CREATE TABLE IF NOT EXISTS __corro_bookkeeping (
+    actor_id BLOB NOT NULL,
+    start_version INTEGER NOT NULL,
+    end_version INTEGER,
+    db_version INTEGER,
+    last_seq INTEGER,
+    ts TEXT,
+    PRIMARY KEY (actor_id, start_version)
+) WITHOUT ROWID;
+CREATE INDEX IF NOT EXISTS __corro_bookkeeping_db_version
+    ON __corro_bookkeeping (db_version);
+
+-- buffered seq ranges of partially received versions
+CREATE TABLE IF NOT EXISTS __corro_seq_bookkeeping (
+    site_id BLOB NOT NULL,
+    version INTEGER NOT NULL,
+    start_seq INTEGER NOT NULL,
+    end_seq INTEGER NOT NULL,
+    last_seq INTEGER NOT NULL,
+    ts TEXT NOT NULL,
+    PRIMARY KEY (site_id, version, start_seq)
+) WITHOUT ROWID;
+
+-- out-of-order buffered changes awaiting gap-free reassembly
+CREATE TABLE IF NOT EXISTS __corro_buffered_changes (
+    "table" TEXT NOT NULL,
+    pk BLOB NOT NULL,
+    cid TEXT NOT NULL,
+    val ANY,
+    col_version INTEGER NOT NULL,
+    db_version INTEGER NOT NULL,
+    site_id BLOB NOT NULL,
+    seq INTEGER NOT NULL,
+    cl INTEGER NOT NULL,
+    version INTEGER NOT NULL,
+    PRIMARY KEY (site_id, db_version, version, seq)
+) WITHOUT ROWID;
+
+-- SWIM membership persistence (ref: agent.rs __corro_members + refactor)
+CREATE TABLE IF NOT EXISTS __corro_members (
+    actor_id BLOB PRIMARY KEY NOT NULL,
+    address TEXT NOT NULL,
+    foca_state JSON,
+    rtt_min INTEGER,
+    cluster_id INTEGER NOT NULL DEFAULT 0
+) WITHOUT ROWID;
+
+-- tracked user schema objects
+CREATE TABLE IF NOT EXISTS __corro_schema (
+    tbl_name TEXT NOT NULL,
+    type TEXT NOT NULL,
+    name TEXT NOT NULL,
+    sql TEXT NOT NULL,
+    source TEXT NOT NULL,
+    PRIMARY KEY (tbl_name, type, name)
+) WITHOUT ROWID;
+
+-- subscription registry (ref: agent.rs __corro_subs)
+CREATE TABLE IF NOT EXISTS __corro_subs (
+    id BLOB PRIMARY KEY NOT NULL,
+    sql TEXT NOT NULL,
+    state TEXT NOT NULL DEFAULT 'created'
+) WITHOUT ROWID;
+"""
+
+
+def migrate(conn: sqlite3.Connection) -> None:
+    """Apply bookkeeping migrations (idempotent DDL; no explicit tx —
+    python's executescript manages its own)."""
+    conn.executescript(INIT_SQL)
+    conn.execute(
+        "INSERT INTO __corro_state (key, value) VALUES ('schema_version', ?) "
+        "ON CONFLICT (key) DO UPDATE SET value = excluded.value",
+        (SCHEMA_VERSION,),
+    )

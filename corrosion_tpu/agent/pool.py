@@ -1,0 +1,134 @@
+"""Read/write-split SQLite connection pool with prioritized writes.
+
+Equivalent of the reference's ``SplitPool`` (crates/corro-types/src/
+agent.rs:433-615): one serialized write connection guarded by a single
+write permit with three priority classes (priority > normal > low,
+agent.rs:507-524), and a pool of read connections.
+
+Blocking SQLite work runs on threads via ``asyncio.to_thread``; the write
+path is serialized so CRDT seq/version allocation stays single-writer, which
+is the engine's concurrency model (and the reference's: 1 RW conn,
+agent.rs:605).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import sqlite3
+import tempfile
+from typing import AsyncIterator, Callable, Optional, TypeVar
+
+from ..crdt import connect
+
+T = TypeVar("T")
+
+PRIORITY_HIGH = 0
+PRIORITY_NORMAL = 1
+PRIORITY_LOW = 2
+
+
+class SplitPool:
+    """1 writer + N readers over the same database file."""
+
+    def __init__(self, path: str, read_conns: int = 4) -> None:
+        self.path = path
+        self._write_conn: Optional[sqlite3.Connection] = None
+        self._read_pool: asyncio.Queue[sqlite3.Connection] = asyncio.Queue()
+        self._n_read = read_conns
+        # one writer at a time; FIFO per priority class, drained high-first
+        self._write_lock = asyncio.Lock()
+        self._waiters: list[list[asyncio.Future]] = [[], [], []]
+        self._opened = False
+
+    def open(self) -> None:
+        if self._opened:
+            return
+        if self.path == ":memory:":
+            # sqlite :memory: is per-connection; the pool needs one shared
+            # database, so back it with an unlinked temp file instead
+            fd, self.path = tempfile.mkstemp(suffix=".db", prefix="corro-mem-")
+            os.close(fd)
+            self._ephemeral = True
+        self._write_conn = connect(self.path)
+        for _ in range(self._n_read):
+            # read-only, like the reference's read pool (agent.rs:494): ad-hoc
+            # SQL through /v1/queries cannot mutate state behind the CRDT
+            # engine's back
+            self._read_pool.put_nowait(connect(self.path, read_only=True))
+        self._opened = True
+
+    def close(self) -> None:
+        if self._write_conn is not None:
+            with contextlib.suppress(Exception):
+                self._write_conn.execute("SELECT crsql_finalize()")
+            self._write_conn.close()
+            self._write_conn = None
+        while not self._read_pool.empty():
+            self._read_pool.get_nowait().close()
+        if getattr(self, "_ephemeral", False):
+            for suffix in ("", "-wal", "-shm"):
+                with contextlib.suppress(OSError):
+                    os.unlink(self.path + suffix)
+        self._opened = False
+
+    # -- reads ------------------------------------------------------------
+
+    @contextlib.asynccontextmanager
+    async def read(self) -> AsyncIterator[sqlite3.Connection]:
+        conn = await self._read_pool.get()
+        try:
+            yield conn
+        finally:
+            self._read_pool.put_nowait(conn)
+
+    async def read_call(self, fn: Callable[[sqlite3.Connection], T]) -> T:
+        async with self.read() as conn:
+            return await asyncio.to_thread(fn, conn)
+
+    # -- writes -----------------------------------------------------------
+
+    @contextlib.asynccontextmanager
+    async def write(
+        self, priority: int = PRIORITY_NORMAL
+    ) -> AsyncIterator[sqlite3.Connection]:
+        """Acquire the single write connection at a priority class
+        (ref: write_priority/write_normal/write_low, agent.rs:507-524)."""
+        await self._acquire_write(priority)
+        try:
+            assert self._write_conn is not None
+            yield self._write_conn
+        finally:
+            self._release_write()
+
+    async def write_call(
+        self, fn: Callable[[sqlite3.Connection], T], priority: int = PRIORITY_NORMAL
+    ) -> T:
+        async with self.write(priority) as conn:
+            return await asyncio.to_thread(fn, conn)
+
+    async def _acquire_write(self, priority: int) -> None:
+        if not self._write_lock.locked():
+            await self._write_lock.acquire()
+            return
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters[priority].append(fut)
+        try:
+            await fut
+        except asyncio.CancelledError:
+            with contextlib.suppress(ValueError):
+                self._waiters[priority].remove(fut)
+            # if we were handed the lock right as we got cancelled, pass it on
+            if fut.done() and not fut.cancelled():
+                self._release_write()
+            raise
+
+    def _release_write(self) -> None:
+        for tier in self._waiters:
+            while tier:
+                fut = tier.pop(0)
+                if not fut.done():
+                    fut.set_result(None)
+                    return
+        self._write_lock.release()

@@ -36,25 +36,37 @@ def _json_contains(selector: Optional[str], obj: Optional[str]) -> bool:
     return contained(vs, vo)
 
 
-def setup_conn(conn: sqlite3.Connection) -> sqlite3.Connection:
+def setup_conn(
+    conn: sqlite3.Connection, read_only: bool = False
+) -> sqlite3.Connection:
     """Apply the standard per-connection pragmas (ref: sqlite.rs setup_conn)."""
-    conn.executescript(
-        """
-        PRAGMA journal_mode = WAL;
-        PRAGMA synchronous = NORMAL;
-        PRAGMA busy_timeout = 5000;
-        PRAGMA foreign_keys = OFF;
-        """
-    )
+    if not read_only:
+        conn.execute("PRAGMA journal_mode = WAL")
+        conn.execute("PRAGMA synchronous = NORMAL")
+    conn.execute("PRAGMA busy_timeout = 5000")
+    conn.execute("PRAGMA foreign_keys = OFF")
     conn.create_function("corro_json_contains", 2, _json_contains, deterministic=True)
     return conn
 
 
-def connect(path: str, load_crdt: bool = True) -> sqlite3.Connection:
-    """Open a database with the CRDT engine loaded (ref: CrConn::init)."""
-    conn = sqlite3.connect(path, timeout=5.0, check_same_thread=False)
+def connect(
+    path: str, load_crdt: bool = True, read_only: bool = False
+) -> sqlite3.Connection:
+    """Open a database with the CRDT engine loaded (ref: CrConn::init).
+
+    ``read_only`` opens in mode=ro (the reference's read pool does the same,
+    agent.rs:494) — safe because the engine's extension init only issues
+    CREATE IF NOT EXISTS, which is a no-op once the writer initialized the
+    database.
+    """
+    if read_only:
+        conn = sqlite3.connect(
+            f"file:{path}?mode=ro", uri=True, timeout=5.0, check_same_thread=False
+        )
+    else:
+        conn = sqlite3.connect(path, timeout=5.0, check_same_thread=False)
     conn.isolation_level = None  # explicit transaction control
-    setup_conn(conn)
+    setup_conn(conn, read_only=read_only)
     if load_crdt:
         so = build()
         conn.enable_load_extension(True)

@@ -1,0 +1,71 @@
+"""Changeset + broadcast wire model.
+
+Equivalent of crates/corro-types/src/broadcast.rs: ``ChangeV1`` (an actor's
+changeset for a version range) and the ``Changeset`` variants, plus the
+payload enums carried by the transport:
+
+- ``UniPayload``   — one-way broadcast stream payloads (uni.rs:51-77)
+- ``BiPayload``    — sync-session stream payloads (bi.rs:21-118)
+- ``BroadcastV1``  — a change broadcast
+
+Changesets come in two shapes (broadcast.rs:30-124):
+- ``Empty``: versions that produced no impactful changes (cleared ranges);
+- ``Full``: one version's column changes covering seq range ``seqs`` out of
+  ``[0, last_seq]`` — ``seqs != (0, last_seq)`` means a partial chunk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from .actor import ActorId
+from .change import Change
+
+
+@dataclass(frozen=True)
+class ChangesetEmpty:
+    """Versions known to contain nothing impactful (ref: Changeset::Empty)."""
+
+    versions: Tuple[int, int]  # inclusive version range
+    ts: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class ChangesetFull:
+    """One version's (possibly partial) changes (ref: Changeset::Full)."""
+
+    version: int
+    changes: Tuple[Change, ...]
+    seqs: Tuple[int, int]  # inclusive seq range covered by this message
+    last_seq: int  # final seq of the whole version
+    ts: int = 0
+
+    @property
+    def versions(self) -> Tuple[int, int]:
+        return (self.version, self.version)
+
+    def is_complete(self) -> bool:
+        return self.seqs == (0, self.last_seq)
+
+    def is_empty_set(self) -> bool:
+        return len(self.changes) == 0
+
+
+Changeset = ChangesetEmpty | ChangesetFull
+
+
+@dataclass(frozen=True)
+class ChangeV1:
+    """A changeset attributed to its originating actor (ref: ChangeV1)."""
+
+    actor_id: ActorId
+    changeset: Changeset
+
+
+class ChangeSource:
+    """Where a change came from — affects rebroadcast policy
+    (ref: corro-agent handlers.rs ChangeSource)."""
+
+    BROADCAST = "broadcast"
+    SYNC = "sync"

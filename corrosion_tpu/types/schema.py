@@ -1,0 +1,273 @@
+"""User schema management: parse, constrain, diff, apply.
+
+Equivalent of crates/corro-types/src/schema.rs: schema files may contain
+only ``CREATE TABLE`` / ``CREATE INDEX`` statements; constraints
+(schema.rs:107-166 ``constrain``):
+
+- tables starting with ``__corro`` / ``crsql`` / ``sqlite`` are reserved;
+- every non-pk NOT NULL column needs a DEFAULT (the CRDT merge path must be
+  able to materialize rows column-by-column);
+- no UNIQUE indexes besides the primary key (uniqueness cannot be enforced
+  across concurrent writers).
+
+``apply_schema`` (schema.rs:266-636) diffs the proposed schema against what
+is recorded in ``__corro_schema``: new tables are created and converted to
+CRRs, existing tables may gain columns (via begin/commit_alter), destructive
+changes are rejected, and indexes are created/dropped to match.
+
+Instead of a hand-rolled SQL AST (the reference uses sqlite3-parser), we
+let SQLite itself parse: statements are applied to a scratch in-memory
+database and introspected via PRAGMA — the parser is the database engine.
+"""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+RESERVED_PREFIXES = ("__corro", "sqlite_", "crsql")
+
+
+class SchemaError(Exception):
+    pass
+
+
+@dataclass
+class Column:
+    name: str
+    type: str
+    notnull: bool
+    default: Optional[str]
+    pk_pos: int  # 0 = not part of pk
+
+
+@dataclass
+class Table:
+    name: str
+    sql: str
+    columns: Dict[str, Column] = field(default_factory=dict)
+
+    @property
+    def pk_cols(self) -> List[str]:
+        pks = [c for c in self.columns.values() if c.pk_pos > 0]
+        return [c.name for c in sorted(pks, key=lambda c: c.pk_pos)]
+
+
+@dataclass
+class Index:
+    name: str
+    tbl_name: str
+    sql: str
+    unique: bool
+
+
+@dataclass
+class Schema:
+    tables: Dict[str, Table] = field(default_factory=dict)
+    indexes: Dict[str, Index] = field(default_factory=dict)
+
+
+def split_statements(sql: str) -> List[str]:
+    """Split a script into complete statements using sqlite's own notion of
+    statement completeness (no hand-rolled string/comment lexing)."""
+    statements: List[str] = []
+    buf = sql
+    while buf.strip():
+        idx = buf.find(";")
+        while idx != -1 and not sqlite3.complete_statement(buf[: idx + 1]):
+            idx = buf.find(";", idx + 1)
+        if idx == -1:
+            statements.append(buf.strip())
+            break
+        stmt = buf[: idx + 1].strip()
+        if stmt.strip(";").strip():
+            statements.append(stmt)
+        buf = buf[idx + 1 :]
+    return statements
+
+
+_CREATE_RE = re.compile(
+    r"^\s*create\s+(?:temp\s+|temporary\s+)?(table|index|unique\s+index|trigger|view|virtual\s+table)\b",
+    re.IGNORECASE,
+)
+
+
+def parse_schema(sql: str) -> Schema:
+    """Parse a schema script (ref: parse_sql, schema.rs:712)."""
+    scratch = sqlite3.connect(":memory:")
+    schema = Schema()
+    for stmt in split_statements(sql):
+        m = _CREATE_RE.match(stmt)
+        if not m:
+            raise SchemaError(
+                f"schema may only contain CREATE TABLE / CREATE INDEX statements, got: {stmt[:80]!r}"
+            )
+        kind = m.group(1).lower().replace("temporary", "temp")
+        if kind not in ("table", "index", "unique index"):
+            raise SchemaError(f"CREATE {kind.upper()} is not allowed in schema files")
+        try:
+            scratch.execute(stmt)
+        except sqlite3.Error as e:
+            raise SchemaError(f"invalid statement: {e}: {stmt[:120]!r}") from e
+
+    for name, sql_text, typ, tbl in scratch.execute(
+        "SELECT name, sql, type, tbl_name FROM sqlite_master"
+    ).fetchall():
+        if typ == "table":
+            if name.startswith("sqlite_"):
+                continue
+            table = Table(name=name, sql=sql_text)
+            for cid, cname, ctype, notnull, dflt, pk in scratch.execute(
+                f'PRAGMA table_info("{name}")'
+            ).fetchall():
+                table.columns[cname] = Column(
+                    name=cname,
+                    type=(ctype or "").upper(),
+                    notnull=bool(notnull),
+                    default=dflt,
+                    pk_pos=pk,
+                )
+            schema.tables[name] = table
+        elif typ == "index" and sql_text:
+            unique = bool(re.match(r"^\s*create\s+unique", sql_text, re.IGNORECASE))
+            schema.indexes[name] = Index(
+                name=name, tbl_name=tbl, sql=sql_text, unique=unique
+            )
+    scratch.close()
+    return schema
+
+
+def constrain(schema: Schema) -> None:
+    """Validate CRR-compatibility (ref: constrain, schema.rs:107-166)."""
+    for table in schema.tables.values():
+        if table.name.startswith(RESERVED_PREFIXES):
+            raise SchemaError(f"table name {table.name!r} is reserved")
+        if not table.pk_cols:
+            raise SchemaError(f"table {table.name!r} must have a primary key")
+        for col in table.columns.values():
+            if col.pk_pos > 0:
+                if not col.notnull:
+                    raise SchemaError(
+                        f"{table.name}.{col.name}: primary key columns must be NOT NULL"
+                    )
+            elif col.notnull and col.default is None:
+                raise SchemaError(
+                    f"{table.name}.{col.name}: NOT NULL columns need a DEFAULT value"
+                )
+    for index in schema.indexes.values():
+        if index.unique:
+            raise SchemaError(
+                f"index {index.name!r}: unique indexes are not supported (cannot be "
+                "enforced across concurrent writers)"
+            )
+        if index.tbl_name not in schema.tables:
+            raise SchemaError(f"index {index.name!r} references unknown table")
+
+
+def read_current_schema(conn: sqlite3.Connection) -> Schema:
+    """Rebuild the recorded schema from __corro_schema (ref: init_schema,
+    schema.rs:200)."""
+    rows = conn.execute(
+        "SELECT tbl_name, type, name, sql FROM __corro_schema"
+    ).fetchall()
+    sql = ";\n".join(r[3] for r in rows)
+    if not sql.strip():
+        return Schema()
+    return parse_schema(sql + ";")
+
+
+def apply_schema(conn: sqlite3.Connection, new_sql: str) -> List[str]:
+    """Diff + apply a new schema (ref: apply_schema, schema.rs:266-636).
+
+    Returns the list of statements executed.  Caller provides a connection
+    with the CRDT engine loaded; runs in its own transaction.
+    """
+    new_schema = parse_schema(new_sql)
+    constrain(new_schema)
+    old_schema = read_current_schema(conn)
+
+    executed: List[str] = []
+
+    def run(sql: str) -> None:
+        conn.execute(sql)
+        executed.append(sql)
+
+    conn.execute("BEGIN")
+    try:
+        for name, table in new_schema.tables.items():
+            old = old_schema.tables.get(name)
+            if old is None:
+                run(table.sql)
+                run(f"SELECT crsql_as_crr('{name}')")
+                run(
+                    f"CREATE INDEX IF NOT EXISTS corro_{name}__crsql_clock_site_id_dbv "
+                    f'ON "{name}__crsql_clock" (site_id, db_version)'
+                )
+            else:
+                if old.pk_cols != table.pk_cols:
+                    raise SchemaError(
+                        f"table {name}: changing the primary key is destructive"
+                    )
+                dropped = set(old.columns) - set(table.columns)
+                if dropped:
+                    raise SchemaError(
+                        f"table {name}: dropping columns {sorted(dropped)} is destructive"
+                    )
+                for cname, col in old.columns.items():
+                    newcol = table.columns[cname]
+                    if (newcol.type, newcol.notnull, newcol.default) != (
+                        col.type,
+                        col.notnull,
+                        col.default,
+                    ):
+                        raise SchemaError(
+                            f"table {name}: changing column {cname} is destructive"
+                        )
+                added = [c for c in table.columns.values() if c.name not in old.columns]
+                if added:
+                    run(f"SELECT crsql_begin_alter('{name}')")
+                    for col in added:
+                        decl = f'ALTER TABLE "{name}" ADD COLUMN "{col.name}" {col.type}'
+                        if col.notnull:
+                            decl += " NOT NULL"
+                        if col.default is not None:
+                            decl += f" DEFAULT {col.default}"
+                        run(decl)
+                    run(f"SELECT crsql_commit_alter('{name}')")
+
+        for name in old_schema.tables:
+            if name not in new_schema.tables:
+                raise SchemaError(f"removing table {name!r} is destructive")
+
+        for name, index in new_schema.indexes.items():
+            old = old_schema.indexes.get(name)
+            if old is None:
+                run(index.sql)
+            elif old.sql != index.sql:
+                run(f'DROP INDEX IF EXISTS "{name}"')
+                run(index.sql)
+        for name in old_schema.indexes:
+            if name not in new_schema.indexes:
+                run(f'DROP INDEX IF EXISTS "{name}"')
+
+        # record the new schema
+        conn.execute("DELETE FROM __corro_schema")
+        for name, table in new_schema.tables.items():
+            conn.execute(
+                "INSERT INTO __corro_schema (tbl_name, type, name, sql, source) "
+                "VALUES (?, 'table', ?, ?, 'api')",
+                (name, name, table.sql),
+            )
+        for name, index in new_schema.indexes.items():
+            conn.execute(
+                "INSERT INTO __corro_schema (tbl_name, type, name, sql, source) "
+                "VALUES (?, 'index', ?, ?, 'api')",
+                (index.tbl_name, name, index.sql),
+            )
+        conn.execute("COMMIT")
+    except BaseException:
+        conn.execute("ROLLBACK")
+        raise
+    return executed

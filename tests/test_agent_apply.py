@@ -1,0 +1,247 @@
+"""Agent bookkeeping + apply-path tests.
+
+Gate for SURVEY.md §7 step 3: BookedVersions semantics, batch apply,
+partial buffering + gap-free flush, empty-changeset compaction
+(ports of the reference's agent/tests.rs version bookkeeping tests).
+"""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.agent import (
+    Agent,
+    AgentConfig,
+    BookedVersions,
+    Cleared,
+    Current,
+    Partial,
+    make_broadcastable_changes,
+)
+from corrosion_tpu.types.actor import ActorId
+from corrosion_tpu.types.broadcast import ChangesetEmpty, ChangesetFull, ChangeV1
+from corrosion_tpu.types.ranges import RangeSet
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+SCHEMA = """
+CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;
+"""
+
+
+def mkagent():
+    agent = Agent(AgentConfig(db_path=":memory:", read_conns=1))
+    agent.pool.open()
+    conn = agent.pool._write_conn
+    conn.executescript(SCHEMA)
+    conn.execute("SELECT crsql_as_crr('tests')")
+    return agent.open_sync()
+
+
+# ---------------------------------------------------------------------------
+# BookedVersions unit semantics
+# ---------------------------------------------------------------------------
+
+
+def test_booked_versions_states():
+    bv = BookedVersions()
+    bv.insert_many((1, 1), Current(db_version=1, last_seq=0, ts=0))
+    assert bv.contains_version(1)
+    assert bv.last() == 1
+    assert not bv.sync_need()
+
+    # a gap appears when a later version arrives first
+    bv.insert_many((4, 4), Current(db_version=4, last_seq=0, ts=0))
+    assert list(bv.sync_need()) == [(2, 3)]
+    bv.insert_many((2, 3), Cleared())
+    assert list(bv.sync_need()) == []
+    assert bv.contains_all((1, 4), None)
+
+
+def test_booked_partial_merge_and_completion():
+    bv = BookedVersions()
+    p1 = bv.insert_many(
+        (5, 5), Partial(seqs=RangeSet([(0, 10)]), last_seq=30, ts=0)
+    )
+    assert not p1.is_complete()
+    p2 = bv.insert_many(
+        (5, 5), Partial(seqs=RangeSet([(11, 30)]), last_seq=30, ts=0)
+    )
+    assert p2.is_complete()
+    assert bv.contains(5, (0, 30))
+    assert not bv.contains(5, (0, 31))
+    # current replaces partial
+    bv.insert_many((5, 5), Current(db_version=9, last_seq=30, ts=0))
+    assert 5 not in bv.partials and bv.contains_current(5)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end apply through two agents
+# ---------------------------------------------------------------------------
+
+
+def test_transact_and_apply_roundtrip():
+    async def main():
+        a, b = mkagent(), mkagent()
+        out = await make_broadcastable_changes(
+            a, [("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "hello"))]
+        )
+        assert out.version == 1 and out.db_version == 1 and out.last_seq == 0
+        assert len(out.changesets) == 1
+        # bookkeeping row mirrored on disk (ref: tests.rs:137-166 assertions)
+        rows = await a.pool.read_call(
+            lambda c: c.execute(
+                "SELECT actor_id, start_version, end_version, db_version, "
+                "last_seq FROM __corro_bookkeeping"
+            ).fetchall()
+        )
+        assert rows == [(a.actor_id, 1, None, 1, 0)]
+
+        await b.process_multiple_changes(out.changesets)
+        got = await b.pool.read_call(
+            lambda c: c.execute("SELECT id, text FROM tests").fetchall()
+        )
+        assert got == [(1, "hello")]
+        book = b.bookie.get(a.actor_id).versions
+        assert book.contains_current(1)
+        # idempotent re-apply
+        res = await b.process_multiple_changes(out.changesets)
+        assert res.applied == []
+        a.close(), b.close()
+
+    run(main())
+
+
+def test_partial_buffering_and_flush():
+    async def main():
+        a, b = mkagent(), mkagent()
+        # one big version on a: 200 rows in one tx
+        stmts = [
+            ("INSERT INTO tests (id, text) VALUES (?, ?)", (i, f"val{i}"))
+            for i in range(200)
+        ]
+        out = await make_broadcastable_changes(a, stmts)
+        assert len(out.changesets) > 1  # chunked by the 8 KiB budget
+
+        # deliver all chunks EXCEPT the first, out of order: must buffer
+        chunks = out.changesets
+        await b.process_multiple_changes(chunks[1:])
+        book = b.bookie.get(a.actor_id).versions
+        assert 1 in book.partials
+        got = await b.pool.read_call(
+            lambda c: c.execute("SELECT COUNT(*) FROM tests").fetchone()
+        )
+        assert got == (0,)  # nothing applied yet
+        buffered = await b.pool.read_call(
+            lambda c: c.execute(
+                "SELECT COUNT(*) FROM __corro_buffered_changes"
+            ).fetchone()
+        )
+        assert buffered[0] > 0
+
+        # the missing first chunk arrives: gap-free -> flushed to the store
+        await b.process_multiple_changes(chunks[:1])
+        book = b.bookie.get(a.actor_id).versions
+        assert book.contains_current(1)
+        got = await b.pool.read_call(
+            lambda c: c.execute("SELECT COUNT(*) FROM tests").fetchone()
+        )
+        assert got == (200,)
+        leftovers = await b.pool.read_call(
+            lambda c: c.execute(
+                "SELECT (SELECT COUNT(*) FROM __corro_buffered_changes), "
+                "(SELECT COUNT(*) FROM __corro_seq_bookkeeping)"
+            ).fetchone()
+        )
+        assert leftovers == (0, 0)
+        a.close(), b.close()
+
+    run(main())
+
+
+def test_store_empty_changeset_compaction():
+    """Port of the reference's empties-merging behavior
+    (agent/tests.rs test_store_empty_changeset)."""
+
+    async def main():
+        b = mkagent()
+        actor = ActorId.random()
+
+        async def clear(versions):
+            await b.process_multiple_changes(
+                [ChangeV1(actor_id=actor, changeset=ChangesetEmpty(versions=versions))]
+            )
+
+        await clear((1, 2))
+        await clear((5, 7))
+        rows = await b.pool.read_call(
+            lambda c: c.execute(
+                "SELECT start_version, end_version FROM __corro_bookkeeping "
+                "WHERE actor_id = ? ORDER BY start_version",
+                (actor,),
+            ).fetchall()
+        )
+        assert rows == [(1, 2), (5, 7)]
+        # bridging range merges all three into one row
+        await clear((3, 4))
+        rows = await b.pool.read_call(
+            lambda c: c.execute(
+                "SELECT start_version, end_version FROM __corro_bookkeeping "
+                "WHERE actor_id = ? ORDER BY start_version",
+                (actor,),
+            ).fetchall()
+        )
+        assert rows == [(1, 7)]
+        book = b.bookie.get(actor).versions
+        assert book.contains_all((1, 7), None)
+        assert list(book.sync_need()) == []
+        b.close()
+
+    run(main())
+
+
+def test_generate_sync_reports_needs_and_partials():
+    async def main():
+        a, b = mkagent(), mkagent()
+        for i in range(3):
+            await make_broadcastable_changes(
+                a, [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, "x"))]
+            )
+        out3 = await make_broadcastable_changes(
+            a, [("INSERT INTO tests (id, text) VALUES (?, ?)", (100, "y"))]
+        )
+        # b only sees version 4: needs 1-3
+        await b.process_multiple_changes(out3.changesets)
+        state = b.generate_sync()
+        assert state.heads[a.actor_id] == 4
+        assert state.need[a.actor_id] == [(1, 3)]
+        a.close(), b.close()
+
+    run(main())
+
+
+def test_restart_restores_bookkeeping(tmp_path):
+    async def main():
+        path = str(tmp_path / "node.db")
+        a = Agent(AgentConfig(db_path=path, read_conns=1))
+        a.pool.open()
+        a.pool._write_conn.executescript(SCHEMA)
+        a.pool._write_conn.execute("SELECT crsql_as_crr('tests')")
+        a.open_sync()
+        await make_broadcastable_changes(
+            a, [("INSERT INTO tests (id, text) VALUES (1, 'persisted')", ())]
+        )
+        actor = a.actor_id
+        a.close()
+
+        a2 = Agent(AgentConfig(db_path=path, read_conns=1)).open_sync()
+        assert a2.actor_id == actor
+        book = a2.bookie.get(actor).versions
+        assert book.contains_current(1)
+        assert a2.generate_sync().heads[actor] == 1
+        a2.close()
+
+    run(main())

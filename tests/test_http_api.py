@@ -1,0 +1,203 @@
+"""HTTP API end-to-end: the minimum single-node slice (SURVEY.md §7 step 4) —
+schema file → write → read back → bookkeeping row, over real HTTP."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import ClientSession
+
+from corrosion_tpu.agent import Agent, AgentConfig
+from corrosion_tpu.api.http import Api
+from corrosion_tpu.types.schema import SchemaError, apply_schema, parse_schema, constrain
+
+SCHEMA = [
+    'CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;'
+]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def boot():
+    agent = Agent(AgentConfig(db_path=":memory:", read_conns=2)).open_sync()
+    api = Api(agent)
+    port = await api.start()
+    return agent, api, f"http://127.0.0.1:{port}"
+
+
+def test_single_node_end_to_end():
+    async def main():
+        agent, api, base = await boot()
+        async with ClientSession() as http:
+            # schema
+            r = await http.post(f"{base}/v1/migrations", json=SCHEMA)
+            assert r.status == 200, await r.text()
+
+            # write (array-of-[sql, params] shape)
+            r = await http.post(
+                f"{base}/v1/transactions",
+                json=[["INSERT INTO tests (id,text) VALUES (?,?)", [1, "hello world 1"]]],
+            )
+            body = await r.json()
+            assert r.status == 200
+            assert body["version"] == 1
+            assert body["results"][0]["rows_affected"] == 1
+
+            # read back over the query stream
+            r = await http.post(f"{base}/v1/queries", json="SELECT id, text FROM tests")
+            lines = [json.loads(l) for l in (await r.text()).strip().splitlines()]
+            assert lines[0] == {"columns": ["id", "text"]}
+            assert lines[1] == {"row": [1, [1, "hello world 1"]]}
+            assert "eoq" in lines[2]
+
+            # bookkeeping row exists (ref: tests.rs:137-166)
+            rows = await agent.pool.read_call(
+                lambda c: c.execute(
+                    "SELECT start_version, db_version, last_seq FROM __corro_bookkeeping"
+                ).fetchall()
+            )
+            assert rows == [(1, 1, 0)]
+
+            # table stats
+            r = await http.post(f"{base}/v1/table_stats", json={})
+            assert (await r.json())["tables"] == {"tests": 1}
+        await api.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_statement_shapes_and_errors():
+    async def main():
+        agent, api, base = await boot()
+        async with ClientSession() as http:
+            await http.post(f"{base}/v1/migrations", json=SCHEMA)
+            # plain string form
+            r = await http.post(
+                f"{base}/v1/transactions",
+                json=["INSERT INTO tests (id, text) VALUES (10, 'plain')"],
+            )
+            assert r.status == 200
+            # named params form
+            r = await http.post(
+                f"{base}/v1/transactions",
+                json=[
+                    {
+                        "query": "INSERT INTO tests (id, text) VALUES (:id, :t)",
+                        "named_params": {"id": 11, "t": "named"},
+                    }
+                ],
+            )
+            assert r.status == 200
+            # malformed statement
+            r = await http.post(f"{base}/v1/transactions", json=[42])
+            assert r.status == 400
+            # empty statement list
+            r = await http.post(f"{base}/v1/transactions", json=[])
+            assert r.status == 400
+            # sql error rolls back and reports
+            r = await http.post(
+                f"{base}/v1/transactions", json=["INSERT INTO nosuch VALUES (1)"]
+            )
+            assert r.status == 400
+            assert "nosuch" in (await r.json())["error"]
+            # query error mid-stream
+            r = await http.post(f"{base}/v1/queries", json="SELECT * FROM nosuch")
+            lines = [json.loads(l) for l in (await r.text()).strip().splitlines()]
+            assert "error" in lines[0]
+        await api.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_authz_bearer_token():
+    async def main():
+        agent = Agent(AgentConfig(db_path=":memory:")).open_sync()
+        api = Api(agent, authz_token="sekrit")
+        port = await api.start()
+        base = f"http://127.0.0.1:{port}"
+        async with ClientSession() as http:
+            r = await http.post(f"{base}/v1/transactions", json=["SELECT 1"])
+            assert r.status == 401
+            r = await http.post(
+                f"{base}/v1/transactions",
+                json=["SELECT 1"],
+                headers={"Authorization": "Bearer sekrit"},
+            )
+            assert r.status == 200
+        await api.stop()
+        agent.close()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# schema management (ref: schema.rs constraints)
+# ---------------------------------------------------------------------------
+
+
+def test_schema_constraints():
+    s = parse_schema("CREATE TABLE t (id INTEGER NOT NULL PRIMARY KEY, v TEXT);")
+    constrain(s)  # fine
+
+    with pytest.raises(SchemaError, match="DEFAULT"):
+        constrain(
+            parse_schema(
+                "CREATE TABLE t (id INTEGER NOT NULL PRIMARY KEY, v TEXT NOT NULL);"
+            )
+        )
+    with pytest.raises(SchemaError, match="primary key"):
+        constrain(parse_schema("CREATE TABLE t (id INTEGER, v TEXT);"))
+    with pytest.raises(SchemaError, match="unique"):
+        constrain(
+            parse_schema(
+                "CREATE TABLE t (id INTEGER NOT NULL PRIMARY KEY, v TEXT);"
+                "CREATE UNIQUE INDEX t_v ON t (v);"
+            )
+        )
+    with pytest.raises(SchemaError, match="reserved"):
+        constrain(
+            parse_schema("CREATE TABLE __corro_t (id INTEGER NOT NULL PRIMARY KEY);")
+        )
+    with pytest.raises(SchemaError, match="only contain"):
+        parse_schema("DROP TABLE x;")
+
+
+def test_schema_migration_add_column_and_reject_destructive():
+    async def main():
+        agent, api, base = await boot()
+        async with ClientSession() as http:
+            r = await http.post(f"{base}/v1/migrations", json=SCHEMA)
+            assert r.status == 200
+            await http.post(
+                f"{base}/v1/transactions",
+                json=[["INSERT INTO tests (id,text) VALUES (1,'pre')", []]],
+            )
+            # add a column
+            r = await http.post(
+                f"{base}/v1/migrations",
+                json=[
+                    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, "
+                    'text TEXT NOT NULL DEFAULT "", extra INTEGER DEFAULT 0) WITHOUT ROWID;'
+                ],
+            )
+            assert r.status == 200, await r.text()
+            r = await http.post(
+                f"{base}/v1/queries", json="SELECT id, text, extra FROM tests"
+            )
+            lines = [json.loads(l) for l in (await r.text()).strip().splitlines()]
+            assert lines[1] == {"row": [1, [1, "pre", 0]]}
+            # dropping the table is destructive
+            r = await http.post(
+                f"{base}/v1/migrations",
+                json=["CREATE TABLE other (id INTEGER NOT NULL PRIMARY KEY);"],
+            )
+            assert r.status == 400
+            assert "destructive" in (await r.json())["error"]
+        await api.stop()
+        agent.close()
+
+    run(main())

@@ -1,0 +1,121 @@
+"""Change ingestion: batch, dedup, apply, rebroadcast.
+
+Equivalent of ``handle_changes`` in crates/corro-agent/src/agent/
+handlers.rs:397-609: incoming changesets (from broadcast uni streams and
+sync sessions) are batched up to ``apply_queue_len`` changes or a flush
+tick, deduplicated against a seen-cache + the bookkeeping, applied in one
+transaction, and — when broadcast-sourced and previously unseen —
+re-broadcast to keep the epidemic going.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+from collections import OrderedDict
+from typing import Callable, List, Optional, Tuple
+
+from ..types.broadcast import ChangeSource, ChangesetFull, ChangeV1
+from .agent import Agent
+
+APPLY_QUEUE_LEN = 600  # ref: handlers.rs apply_queue_len default
+FLUSH_INTERVAL = 0.05  # ref: handlers.rs 50ms flush tick
+SEEN_CACHE_SIZE = 10_000  # ref: handlers.rs seen dedup cache of 10k
+
+
+class ChangeIngest:
+    """One node's ingestion pipeline (ref: handle_changes)."""
+
+    def __init__(
+        self,
+        agent: Agent,
+        rebroadcast: Optional[Callable] = None,
+        notify: Optional[Callable] = None,
+    ) -> None:
+        self.agent = agent
+        # async callback(list[ChangeV1]) -> None, fans back out
+        self.rebroadcast = rebroadcast
+        # async callback(list[(actor_id, Changeset)]) — subscription matching
+        self.notify = notify
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self._seen: "OrderedDict[tuple, None]" = OrderedDict()
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+
+    async def submit(self, change: ChangeV1, source: str) -> None:
+        await self.queue.put((change, source))
+
+    def _seen_key(self, change: ChangeV1) -> tuple:
+        cs = change.changeset
+        seqs = cs.seqs if isinstance(cs, ChangesetFull) else None
+        return (change.actor_id, cs.versions, seqs)
+
+    def _check_seen(self, key: tuple) -> bool:
+        if key in self._seen:
+            return True
+        self._seen[key] = None
+        if len(self._seen) > SEEN_CACHE_SIZE:
+            self._seen.popitem(last=False)
+        return False
+
+    async def _run(self) -> None:
+        while True:
+            batch: List[Tuple[ChangeV1, str]] = [await self.queue.get()]
+            deadline = asyncio.get_running_loop().time() + FLUSH_INTERVAL
+            while len(batch) < APPLY_QUEUE_LEN:
+                timeout = deadline - asyncio.get_running_loop().time()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(
+                        await asyncio.wait_for(self.queue.get(), timeout)
+                    )
+                except asyncio.TimeoutError:
+                    break
+            try:
+                await self._process_batch(batch)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "change batch failed; will be retried via sync"
+                )
+
+    async def _process_batch(self, batch: List[Tuple[ChangeV1, str]]) -> None:
+        to_apply: List[ChangeV1] = []
+        to_rebroadcast: List[ChangeV1] = []
+        for change, source in batch:
+            key = self._seen_key(change)
+            if self._check_seen(key):
+                continue
+            cs = change.changeset
+            booked = self.agent.bookie.get(change.actor_id)
+            seqs = cs.seqs if isinstance(cs, ChangesetFull) else None
+            if booked is not None and booked.versions.contains_all(
+                cs.versions, seqs
+            ):
+                continue  # already known; do not re-apply or re-gossip
+            to_apply.append(change)
+            if source == ChangeSource.BROADCAST:
+                to_rebroadcast.append(change)
+        if not to_apply:
+            return
+        try:
+            result = await self.agent.process_multiple_changes(to_apply)
+        except Exception:
+            # failed batches must not kill the loop; drop seen-markers so the
+            # changes can be retried via sync
+            for change, _ in batch:
+                self._seen.pop(self._seen_key(change), None)
+            raise
+        if self.rebroadcast is not None and to_rebroadcast:
+            await self.rebroadcast(to_rebroadcast)
+        if self.notify is not None and result.applied:
+            await self.notify(result.applied)

@@ -1,0 +1,314 @@
+"""Node: the full per-node runtime task tree.
+
+Equivalent of crates/corro-agent/src/agent/run_root.rs ``start_with_config``
++ ``run`` — wires together the store/agent, transport, SWIM driver,
+broadcast runtime, change ingestion, sync loop, member persistence, and the
+HTTP API, and owns graceful shutdown (the reference's Tripwire + counted
+task drain maps to asyncio task cancellation here).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import random
+import time
+from typing import List, Optional, Tuple
+
+from ..api.http import Api
+from ..broadcast.runtime import BroadcastRuntime
+from ..swim.core import Swim, SwimConfig
+from ..sync.session import SyncServer, parallel_sync
+from ..transport.net import Transport
+from ..types.actor import Actor, ActorId
+from ..types.broadcast import ChangeSource, ChangeV1
+from ..types.config import Config, parse_addr
+from ..types.members import Members
+from ..types.schema import apply_schema
+from .. import wire
+from .agent import Agent, AgentConfig
+from .handlers import ChangeIngest
+
+logger = logging.getLogger(__name__)
+
+SWIM_TICK = 0.1
+MEMBERS_PERSIST_INTERVAL = 60.0  # ref: broadcast/mod.rs:602-734 (60 s diff)
+ANNOUNCE_BACKOFF_MIN = 5.0  # ref: handlers.rs:178-222
+ANNOUNCE_BACKOFF_MAX = 120.0
+
+
+class Node:
+    """A full corrosion node (ref: run_root.rs task tree)."""
+
+    def __init__(self, config: Optional[Config] = None) -> None:
+        self.config = config or Config()
+        self.agent = Agent(
+            AgentConfig(
+                db_path=self.config.db.path,
+                read_conns=self.config.db.read_conns,
+            )
+        )
+        self.members: Optional[Members] = None
+        self.swim: Optional[Swim] = None
+        self.transport: Optional[Transport] = None
+        self.broadcast: Optional[BroadcastRuntime] = None
+        self.ingest: Optional[ChangeIngest] = None
+        self.sync_server: Optional[SyncServer] = None
+        self.api: Optional[Api] = None
+        self._tasks: List[asyncio.Task] = []
+        self._started = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> "Node":
+        gossip_host, gossip_port = parse_addr(self.config.gossip.addr)
+        api_host, api_port = parse_addr(self.config.api.addr)
+        cluster_id = self.config.gossip.cluster_id
+
+        self.agent.open_sync()
+        for path in self.config.db.schema_paths:
+            with open(path) as f:
+                sql = f.read()
+            await self.agent.pool.write_call(lambda c, s=sql: apply_schema(c, s))
+
+        self.members = Members(self.agent.actor_id)
+        self.sync_server = SyncServer(self.agent, cluster_id)
+        self.transport = Transport(
+            host=gossip_host,
+            port=gossip_port,
+            on_datagram=self._on_datagram,
+            on_uni_frame=self._on_uni_frame,
+            on_bi_stream=self._on_bi_stream,
+        )
+        addr = await self.transport.start()
+        self.transport.on_rtt = lambda a, rtt: self._on_rtt(a, rtt)
+
+        identity = Actor(
+            id=self.agent.actor_id,
+            addr=addr,
+            ts=self.agent.clock.new_timestamp(),
+            cluster_id=cluster_id,
+        )
+        self.swim = Swim(
+            identity,
+            SwimConfig(
+                probe_period=self.config.gossip.probe_period,
+                probe_timeout=self.config.gossip.probe_timeout,
+                suspicion_timeout=self.config.gossip.suspicion_timeout,
+            ),
+            now=time.monotonic(),
+        )
+        self.broadcast = BroadcastRuntime(
+            self.transport,
+            self.members,
+            cluster_id=cluster_id,
+            max_transmissions=self.config.gossip.max_transmissions,
+        )
+        self.ingest = ChangeIngest(
+            self.agent,
+            rebroadcast=lambda changes: self.broadcast.enqueue(
+                changes, rebroadcast=True
+            ),
+        )
+        self.api = Api(
+            self.agent,
+            broadcast_hook=lambda changes: self.broadcast.enqueue(changes),
+            authz_token=self.config.api.authz_bearer,
+        )
+        await self.api.start(api_host, api_port)
+
+        self.broadcast.start()
+        self.ingest.start()
+        self._tasks.append(asyncio.create_task(self._swim_loop()))
+        self._tasks.append(asyncio.create_task(self._sync_loop()))
+        self._tasks.append(asyncio.create_task(self._persist_members_loop()))
+        self._tasks.append(asyncio.create_task(self._announce_loop()))
+        self._started = True
+        return self
+
+    async def stop(self) -> None:
+        """Graceful shutdown (ref: Tripwire poisoning + drain,
+        handlers.rs:70-77 + broadcast/mod.rs:323-372 leave_cluster)."""
+        if self.swim is not None:
+            self.swim.leave()
+            await self._pump_swim()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            with contextlib.suppress(asyncio.CancelledError):
+                await t
+        self._tasks.clear()
+        if self.ingest is not None:
+            await self.ingest.stop()
+        if self.broadcast is not None:
+            await self.broadcast.stop()
+        if self.api is not None:
+            await self.api.stop()
+        if self.transport is not None:
+            await self.transport.stop()
+        self.agent.close()
+        self._started = False
+
+    # -- addresses --------------------------------------------------------
+
+    @property
+    def gossip_addr(self) -> Tuple[str, int]:
+        return (self.transport.host, self.transport.port)
+
+    @property
+    def api_base(self) -> str:
+        return f"http://127.0.0.1:{self.api.port}"
+
+    # -- swim plumbing ----------------------------------------------------
+
+    def _on_datagram(self, addr, data: bytes) -> None:
+        assert self.swim is not None
+        try:
+            msg = wire.decode_swim(data)
+            self.swim.handle(msg, time.monotonic())
+        except (wire.WireError, ValueError, TypeError, IndexError):
+            # malformed peer datagrams must not escape into the event loop's
+            # protocol callback (remotely triggerable log flood otherwise)
+            logger.debug("dropping malformed datagram from %s", addr)
+
+    async def _pump_swim(self) -> None:
+        assert self.swim is not None and self.transport is not None
+        for dest, msg in self.swim.take_outputs():
+            self.transport.send_datagram(dest, wire.encode_swim(msg))
+        for actor, what in self.swim.take_events():
+            if what == "up":
+                if self.members.add_member(actor):
+                    logger.debug("member up: %s", actor.id.as_simple())
+            elif what == "down":
+                self.members.remove_member(actor)
+
+    async def _swim_loop(self) -> None:
+        assert self.swim is not None
+        while True:
+            self.swim.tick(time.monotonic())
+            await self._pump_swim()
+            await asyncio.sleep(SWIM_TICK)
+
+    def _on_rtt(self, addr, rtt_ms: float) -> None:
+        if self.members is None:
+            return
+        for member in self.members.states.values():
+            if member.addr == addr:
+                self.members.add_rtt(member.actor.id, rtt_ms)
+                break
+
+    async def _announce_loop(self) -> None:
+        """Bootstrap announcements with backoff (ref: handlers.rs:178-222 +
+        bootstrap.rs)."""
+        assert self.swim is not None
+        backoff = ANNOUNCE_BACKOFF_MIN
+        while True:
+            if not self.members.up_members():
+                for spec in self.config.gossip.bootstrap:
+                    with contextlib.suppress(ValueError):
+                        self.swim.announce(parse_addr(spec))
+                await self._pump_swim()
+                await asyncio.sleep(backoff + random.uniform(0, 1))
+                # backoff escalates only across consecutive isolated rounds
+                backoff = min(backoff * 2, ANNOUNCE_BACKOFF_MAX)
+            else:
+                backoff = ANNOUNCE_BACKOFF_MIN
+                await asyncio.sleep(ANNOUNCE_BACKOFF_MIN)
+
+    async def _persist_members_loop(self) -> None:
+        """Persist membership every 60 s (ref: broadcast/mod.rs:602-734)."""
+        while True:
+            await asyncio.sleep(MEMBERS_PERSIST_INTERVAL)
+            await self.persist_members()
+
+    async def persist_members(self) -> None:
+        assert self.members is not None
+        rows = [
+            (
+                m.actor.id,
+                f"{m.addr[0]}:{m.addr[1]}",
+                json.dumps({"state": m.state, "ts": m.actor.ts}),
+                m.rtt_min(),
+                m.actor.cluster_id,
+            )
+            for m in self.members.states.values()
+        ]
+
+        def _write(conn):
+            conn.execute("BEGIN")
+            try:
+                conn.execute("DELETE FROM __corro_members")
+                conn.executemany(
+                    "INSERT INTO __corro_members (actor_id, address, "
+                    "foca_state, rtt_min, cluster_id) VALUES (?,?,?,?,?)",
+                    rows,
+                )
+                conn.execute("COMMIT")
+            except BaseException:
+                conn.execute("ROLLBACK")
+                raise
+
+        await self.agent.pool.write_call(_write)
+
+    # -- stream plumbing --------------------------------------------------
+
+    async def _on_uni_frame(self, addr, payload: bytes) -> None:
+        try:
+            kind, data = wire.decode_uni(payload)
+        except wire.WireError:
+            return
+        if kind != "bcast":
+            return
+        change, cluster_id, _rebroadcast = data
+        if cluster_id != self.config.gossip.cluster_id:
+            return  # ref: uni.rs:63 cluster filter
+        assert self.ingest is not None
+        await self.ingest.submit(change, ChangeSource.BROADCAST)
+
+    async def _on_bi_stream(self, addr, fs) -> None:
+        assert self.sync_server is not None
+        with contextlib.suppress(
+            ConnectionError, asyncio.TimeoutError, wire.WireError
+        ):
+            await self.sync_server.serve(addr, fs)
+
+    # -- sync loop ---------------------------------------------------------
+
+    async def _sync_loop(self) -> None:
+        """Backoff-paced anti-entropy rounds (ref: sync_loop,
+        util.rs:602-679: 1 s → 15 s backoff)."""
+        interval = self.config.perf.sync_interval_min
+        while True:
+            await asyncio.sleep(interval + random.uniform(0, interval * 0.1))
+            try:
+                received = await self.sync_once()
+            except Exception:
+                logger.exception("sync round failed")
+                received = 0
+            if received > 0:
+                interval = self.config.perf.sync_interval_min
+            else:
+                interval = min(interval * 2, self.config.perf.sync_interval_max)
+
+    async def sync_once(self) -> int:
+        """One sync round with chosen peers (ref: handle_sync,
+        handlers.rs:616-700: desired = clamp(N/100, 3, 10), lowest RTT
+        ring first)."""
+        assert self.members is not None and self.transport is not None
+        ups = self.members.up_members()
+        if not ups:
+            return 0
+        desired = max(3, min(10, len(ups) // 100 or 3))
+        ranked = sorted(
+            ups, key=lambda m: (m.ring if m.ring is not None else 9)
+        )
+        chosen = [(m.actor.id, m.addr) for m in ranked[:desired]]
+        return await parallel_sync(
+            self.agent,
+            self.transport,
+            chosen,
+            submit=self.ingest.submit,
+            cluster_id=self.config.gossip.cluster_id,
+        )

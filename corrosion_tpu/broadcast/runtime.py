@@ -1,0 +1,116 @@
+"""Epidemic broadcast: fanout + retransmission budget.
+
+Equivalent of the broadcast half of crates/corro-agent/src/broadcast/
+mod.rs:376-599 (``runtime_loop`` task #2):
+
+- fresh local/rebroadcast changesets go immediately to every ring-0
+  (lowest-RTT) member (mod.rs:488-498);
+- plus ``max(num_indirect_probes, (N - ring0) / (max_transmissions * 10))``
+  random other members (mod.rs:534-547);
+- each pending broadcast is re-sent to random members every ``resend_tick``
+  until its ``send_count`` reaches ``max_transmissions`` (mod.rs:583-595).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import random
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from ..types.broadcast import ChangeV1
+from ..types.members import Members
+from ..wire import encode_uni_broadcast
+from ..transport.net import Transport
+
+NUM_INDIRECT_PROBES = 3  # ref: foca WAN config / broadcast/mod.rs:534
+DEFAULT_MAX_TRANSMISSIONS = 15
+RESEND_TICK = 0.5  # ref: broadcast/mod.rs:591 (500 ms)
+
+
+@dataclass
+class PendingBroadcast:
+    """ref: broadcast/mod.rs:747-773"""
+
+    payload: bytes
+    send_count: int = 0
+
+
+class BroadcastRuntime:
+    """Owns the broadcast queue + retransmission loop for one node."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        members: Members,
+        cluster_id: int = 0,
+        max_transmissions: int = DEFAULT_MAX_TRANSMISSIONS,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        self.transport = transport
+        self.members = members
+        self.cluster_id = cluster_id
+        self.max_transmissions = max_transmissions
+        self.rng = rng or random.Random()
+        self.pending: List[PendingBroadcast] = []
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self._resend_task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run())
+        self._resend_task = asyncio.create_task(self._resend_loop())
+
+    async def stop(self) -> None:
+        for t in (self._task, self._resend_task):
+            if t is not None:
+                t.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await t
+
+    async def enqueue(self, changes: List[ChangeV1], rebroadcast: bool = False) -> None:
+        for cv in changes:
+            await self._queue.put((cv, rebroadcast))
+
+    # -- internals --------------------------------------------------------
+
+    async def _run(self) -> None:
+        while True:
+            cv, rebroadcast = await self._queue.get()
+            payload = encode_uni_broadcast(cv, self.cluster_id, rebroadcast)
+            await self._initial_fanout(payload)
+
+    async def _initial_fanout(self, payload: bytes) -> None:
+        ups = self.members.up_members()
+        ring0 = self.members.ring0()
+        ring0_ids = {m.actor.id for m in ring0}
+        others = [m for m in ups if m.actor.id not in ring0_ids]
+        n_random = max(
+            NUM_INDIRECT_PROBES,
+            len(others) // (self.max_transmissions * 10) or 0,
+        )
+        self.rng.shuffle(others)
+        targets = ring0 + others[:n_random]
+        for member in targets:
+            with contextlib.suppress(OSError, ConnectionError):
+                await self.transport.send_uni(member.addr, payload)
+        if others[n_random:]:
+            self.pending.append(PendingBroadcast(payload=payload, send_count=1))
+
+    async def _resend_loop(self) -> None:
+        while True:
+            await asyncio.sleep(RESEND_TICK)
+            if not self.pending:
+                continue
+            ups = self.members.up_members()
+            if not ups:
+                continue
+            for pb in list(self.pending):
+                sample = self.rng.sample(ups, min(NUM_INDIRECT_PROBES, len(ups)))
+                for member in sample:
+                    with contextlib.suppress(OSError, ConnectionError):
+                        await self.transport.send_uni(member.addr, pb.payload)
+                pb.send_count += 1
+                if pb.send_count >= self.max_transmissions:
+                    self.pending.remove(pb)

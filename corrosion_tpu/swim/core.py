@@ -1,0 +1,416 @@
+"""Sans-IO SWIM membership state machine.
+
+Equivalent of the `foca` crate (the SWIM core the reference drives from
+crates/corro-agent/src/broadcast/mod.rs:162-374): failure detection by
+randomized probing with indirect probes, suspicion with refutation by
+incarnation bump, epidemic piggyback of membership updates, and
+announce/feed joining.  The reference's WAN tuning knobs
+(broadcast/mod.rs:736-745: ``max_packet_size`` 1178, ``num_indirect_probes``
+3, ``remove_down_after`` 48 h) appear here as ``SwimConfig`` fields.
+
+Sans-IO: no sockets, no clocks, no tasks.  The caller feeds decoded
+messages + explicit ``now`` timestamps and drains (destination, message)
+outputs and membership events.  This makes the core:
+- unit-testable with virtual time (no sleeps — improving on the reference,
+  whose multi-node tests all use real sockets, SURVEY §4);
+- drivable by the in-process cluster harness with a seeded RNG;
+- the executable spec for the vectorized SWIM in corrosion_tpu.sim.
+
+Message wire shapes (tuples; encoded by corrosion_tpu.wire.encode_swim):
+  ("ping",      seq, from_actor, piggyback)
+  ("ping_req",  seq, origin_actor, target_actor, piggyback)
+  ("fwd_ping",  seq, origin_actor, from_actor, piggyback)
+  ("ack",       seq, from_actor, piggyback)
+  ("announce",  from_actor)
+  ("feed",      from_actor, [actor...], piggyback)
+  ("leave",     from_actor)
+
+Piggyback entries: (actor_tuple, state, incarnation) with state in
+{"alive", "suspect", "down"}.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..types.actor import Actor, ActorId
+from ..wire import actor_from_obj, actor_to_obj
+
+ALIVE, SUSPECT, DOWN = "alive", "suspect", "down"
+
+
+@dataclass
+class SwimConfig:
+    probe_period: float = 1.0  # seconds between probe rounds
+    probe_timeout: float = 0.5  # direct ack deadline; indirect get another
+    num_indirect_probes: int = 3  # ref: foca WAN config
+    suspicion_timeout: float = 3.0
+    max_piggyback: int = 8  # updates per message (≈ 1178-byte datagram budget)
+    update_retransmits: int = 6  # times each update is piggybacked
+    remove_down_after: float = 48 * 3600.0  # ref: broadcast/mod.rs:744
+
+
+@dataclass
+class MemberEntry:
+    actor: Actor
+    state: str = ALIVE
+    incarnation: int = 0
+    state_since: float = 0.0
+
+
+@dataclass
+class _Update:
+    actor_obj: tuple
+    state: str
+    incarnation: int
+    sends_left: int
+
+
+class Swim:
+    """One node's SWIM state machine."""
+
+    def __init__(
+        self,
+        identity: Actor,
+        config: Optional[SwimConfig] = None,
+        rng: Optional[random.Random] = None,
+        now: float = 0.0,
+    ) -> None:
+        self.identity = identity
+        self.config = config or SwimConfig()
+        self.rng = rng or random.Random()
+        self.incarnation = 0
+        self.members: Dict[ActorId, MemberEntry] = {}
+        self._updates: List[_Update] = []
+        self._out: List[Tuple[Tuple[str, int], tuple]] = []
+        self._events: List[Tuple[Actor, str]] = []
+        self._next_probe_at = now + self.rng.uniform(0, self.config.probe_period)
+        self._probe_seq = 0
+        # seq -> (target ActorId, direct_deadline, indirect_deadline, acked)
+        self._probes: Dict[int, list] = {}
+        # probe order shuffling (round-robin through shuffled membership)
+        self._probe_queue: List[ActorId] = []
+        self._left = False
+
+    # -- helpers ----------------------------------------------------------
+
+    def _emit(self, addr: Tuple[str, int], msg: tuple) -> None:
+        self._out.append(((addr[0], addr[1]), msg))
+
+    def _event(self, actor: Actor, what: str) -> None:
+        self._events.append((actor, what))
+
+    def _queue_update(self, actor: Actor, state: str, incarnation: int) -> None:
+        self._updates.insert(
+            0,
+            _Update(
+                actor_obj=actor_to_obj(actor),
+                state=state,
+                incarnation=incarnation,
+                sends_left=self.config.update_retransmits,
+            ),
+        )
+
+    def _piggyback(self) -> list:
+        out = []
+        for upd in list(self._updates):
+            if len(out) >= self.config.max_piggyback:
+                break
+            out.append([list(upd.actor_obj), upd.state, upd.incarnation])
+            upd.sends_left -= 1
+            if upd.sends_left <= 0:
+                self._updates.remove(upd)
+        return out
+
+    def take_outputs(self) -> List[Tuple[Tuple[str, int], tuple]]:
+        out, self._out = self._out, []
+        return out
+
+    def take_events(self) -> List[Tuple[Actor, str]]:
+        ev, self._events = self._events, []
+        return ev
+
+    def up_members(self) -> List[Actor]:
+        return [m.actor for m in self.members.values() if m.state != DOWN]
+
+    # -- joining ----------------------------------------------------------
+
+    def announce(self, addr: Tuple[str, int]) -> None:
+        """Join via a bootstrap address (ref: foca Announce;
+        handlers.rs:178-222 drives this with backoff)."""
+        self._emit(addr, ("announce", actor_to_obj(self.identity)))
+
+    def leave(self) -> None:
+        """Graceful departure (ref: foca leave_cluster,
+        broadcast/mod.rs:323-372)."""
+        self._left = True
+        self.incarnation += 1
+        msg = ("leave", actor_to_obj(self.identity))
+        for m in self.up_members():
+            self._emit(m.addr, msg)
+
+    # -- timers -----------------------------------------------------------
+
+    def tick(self, now: float) -> None:
+        if self._left:
+            return
+        # probe deadlines
+        for seq, st in list(self._probes.items()):
+            target_id, direct_dl, indirect_dl, acked, indirect_sent = st
+            entry = self.members.get(target_id)
+            if acked or entry is None or entry.state == DOWN:
+                del self._probes[seq]
+                continue
+            if now >= direct_dl and not indirect_sent:
+                st[4] = True
+                helpers = [
+                    m
+                    for m in self.members.values()
+                    if m.state == ALIVE and m.actor.id != target_id
+                ]
+                self.rng.shuffle(helpers)
+                for helper in helpers[: self.config.num_indirect_probes]:
+                    self._emit(
+                        helper.actor.addr,
+                        (
+                            "ping_req",
+                            seq,
+                            actor_to_obj(self.identity),
+                            actor_to_obj(entry.actor),
+                            self._piggyback(),
+                        ),
+                    )
+            elif now >= indirect_dl:
+                del self._probes[seq]
+                self._suspect(entry, now)
+        # suspicion expiry
+        for entry in list(self.members.values()):
+            if (
+                entry.state == SUSPECT
+                and now - entry.state_since >= self.config.suspicion_timeout
+            ):
+                self._declare_down(entry, now)
+            elif (
+                entry.state == DOWN
+                and now - entry.state_since >= self.config.remove_down_after
+            ):
+                del self.members[entry.actor.id]
+        # probe round
+        if now >= self._next_probe_at:
+            self._next_probe_at = now + self.config.probe_period
+            self._probe_next(now)
+
+    def _probe_next(self, now: float) -> None:
+        candidates = [m for m in self.members.values() if m.state != DOWN]
+        if not candidates:
+            return
+        if not self._probe_queue:
+            self._probe_queue = [m.actor.id for m in candidates]
+            self.rng.shuffle(self._probe_queue)
+        while self._probe_queue:
+            target_id = self._probe_queue.pop(0)
+            entry = self.members.get(target_id)
+            if entry is not None and entry.state != DOWN:
+                self._probe_seq += 1
+                seq = self._probe_seq
+                self._probes[seq] = [
+                    target_id,
+                    now + self.config.probe_timeout,
+                    now + 2 * self.config.probe_timeout,
+                    False,
+                    False,
+                ]
+                self._emit(
+                    entry.actor.addr,
+                    ("ping", seq, actor_to_obj(self.identity), self._piggyback()),
+                )
+                return
+
+    # -- state transitions -------------------------------------------------
+
+    def _suspect(self, entry: MemberEntry, now: float) -> None:
+        if entry.state != ALIVE:
+            return
+        entry.state = SUSPECT
+        entry.state_since = now
+        self._queue_update(entry.actor, SUSPECT, entry.incarnation)
+
+    def _declare_down(self, entry: MemberEntry, now: float) -> None:
+        if entry.state == DOWN:
+            return
+        entry.state = DOWN
+        entry.state_since = now
+        self._queue_update(entry.actor, DOWN, entry.incarnation)
+        self._event(entry.actor, "down")
+
+    def _observe_alive(
+        self, actor: Actor, incarnation: int, now: float, direct: bool = False
+    ) -> None:
+        """An actor is claimed alive at some incarnation.  ``direct`` marks
+        first-hand evidence (we just received a message from the actor
+        itself), which revives even DOWN entries of the same incarnation —
+        this is how a healed partition re-merges without waiting for
+        identity renewal."""
+        if actor.id == self.identity.id:
+            return
+        entry = self.members.get(actor.id)
+        if entry is None:
+            entry = MemberEntry(
+                actor=actor, state=ALIVE, incarnation=incarnation, state_since=now
+            )
+            self.members[actor.id] = entry
+            self._queue_update(actor, ALIVE, incarnation)
+            self._event(actor, "up")
+            return
+        # newer identity (rejoin via renew(), ref: actor.rs:199-210), higher
+        # incarnation (refuted suspicion), or direct first-hand contact
+        if (
+            actor.ts > entry.actor.ts
+            or (actor.ts == entry.actor.ts and incarnation > entry.incarnation)
+            or (direct and actor.ts >= entry.actor.ts and entry.state != ALIVE)
+        ):
+            was_down_or_suspect = entry.state != ALIVE
+            entry.actor = actor
+            entry.incarnation = max(incarnation, entry.incarnation)
+            entry.state = ALIVE
+            entry.state_since = now
+            self._queue_update(actor, ALIVE, entry.incarnation)
+            if was_down_or_suspect:
+                self._event(actor, "up")
+
+    def _observe_suspect(self, actor: Actor, incarnation: int, now: float) -> None:
+        if actor.id == self.identity.id:
+            # that's us! refute with a higher incarnation
+            self.incarnation = max(self.incarnation, incarnation) + 1
+            self._queue_update(self.identity, ALIVE, self.incarnation)
+            return
+        entry = self.members.get(actor.id)
+        if entry is None:
+            entry = MemberEntry(
+                actor=actor, state=SUSPECT, incarnation=incarnation, state_since=now
+            )
+            self.members[actor.id] = entry
+            self._queue_update(actor, SUSPECT, incarnation)
+            self._event(actor, "up")  # first sighting, albeit suspect
+            return
+        if actor.ts < entry.actor.ts:
+            return
+        if incarnation >= entry.incarnation and entry.state == ALIVE:
+            entry.state = SUSPECT
+            entry.state_since = now
+            entry.incarnation = incarnation
+            self._queue_update(actor, SUSPECT, incarnation)
+
+    def _observe_down(self, actor: Actor, incarnation: int, now: float) -> None:
+        if actor.id == self.identity.id:
+            # someone declared us dead: refute loudly
+            self.incarnation = max(self.incarnation, incarnation) + 1
+            self._queue_update(self.identity, ALIVE, self.incarnation)
+            return
+        entry = self.members.get(actor.id)
+        if entry is None:
+            return
+        if actor.ts < entry.actor.ts:
+            return  # stale notice about an older identity of a rejoined node
+        if actor.ts > entry.actor.ts or incarnation >= entry.incarnation:
+            if entry.state != DOWN:
+                self._declare_down(entry, now)
+
+    def _apply_piggyback(self, updates: list, now: float) -> None:
+        for actor_obj, state, incarnation in updates:
+            actor = actor_from_obj(actor_obj)
+            if state == ALIVE:
+                self._observe_alive(actor, incarnation, now)
+            elif state == SUSPECT:
+                self._observe_suspect(actor, incarnation, now)
+            elif state == DOWN:
+                self._observe_down(actor, incarnation, now)
+
+    # -- message handling --------------------------------------------------
+
+    def handle(self, msg: tuple, now: float) -> None:
+        if self._left:
+            return
+        kind = msg[0]
+        if kind == "ping":
+            _, seq, from_obj, pb = msg
+            sender = actor_from_obj(from_obj)
+            self._observe_alive(sender, 0, now, direct=True)
+            self._apply_piggyback(pb, now)
+            self._emit(
+                sender.addr,
+                ("ack", seq, actor_to_obj(self.identity), self._piggyback()),
+            )
+        elif kind == "fwd_ping":
+            _, seq, origin_obj, from_obj, pb = msg
+            origin = actor_from_obj(origin_obj)
+            self._observe_alive(actor_from_obj(from_obj), 0, now, direct=True)
+            self._observe_alive(origin, 0, now)
+            self._apply_piggyback(pb, now)
+            # ack straight to the origin of the indirect probe
+            self._emit(
+                origin.addr,
+                ("ack", seq, actor_to_obj(self.identity), self._piggyback()),
+            )
+        elif kind == "ping_req":
+            _, seq, origin_obj, target_obj, pb = msg
+            self._apply_piggyback(pb, now)
+            target = actor_from_obj(target_obj)
+            self._emit(
+                target.addr,
+                (
+                    "fwd_ping",
+                    seq,
+                    origin_obj,
+                    actor_to_obj(self.identity),
+                    self._piggyback(),
+                ),
+            )
+        elif kind == "ack":
+            _, seq, from_obj, pb = msg
+            sender = actor_from_obj(from_obj)
+            self._apply_piggyback(pb, now)
+            st = self._probes.get(seq)
+            if st is not None and st[0] == sender.id:
+                st[3] = True
+                del self._probes[seq]
+            entry = self.members.get(sender.id)
+            if entry is not None and entry.state == SUSPECT:
+                entry.state = ALIVE
+                entry.state_since = now
+                self._queue_update(sender, ALIVE, entry.incarnation)
+            else:
+                self._observe_alive(sender, 0, now, direct=True)
+        elif kind == "announce":
+            (_, from_obj) = msg
+            sender = actor_from_obj(from_obj)
+            self._observe_alive(sender, 0, now, direct=True)
+            feed = [
+                actor_to_obj(m.actor)
+                for m in self.members.values()
+                if m.state == ALIVE and m.actor.id != sender.id
+            ]
+            self.rng.shuffle(feed)
+            self._emit(
+                sender.addr,
+                (
+                    "feed",
+                    actor_to_obj(self.identity),
+                    feed[:10],
+                    self._piggyback(),
+                ),
+            )
+        elif kind == "feed":
+            _, from_obj, actors, pb = msg
+            self._observe_alive(actor_from_obj(from_obj), 0, now, direct=True)
+            for actor_obj in actors:
+                self._observe_alive(actor_from_obj(actor_obj), 0, now)
+            self._apply_piggyback(pb, now)
+        elif kind == "leave":
+            (_, from_obj) = msg
+            actor = actor_from_obj(from_obj)
+            entry = self.members.get(actor.id)
+            if entry is not None and actor.ts >= entry.actor.ts:
+                self._declare_down(entry, now)

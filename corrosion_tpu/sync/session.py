@@ -1,0 +1,366 @@
+"""Anti-entropy sync sessions over bidirectional streams.
+
+Equivalent of crates/corro-agent/src/api/peer.rs: the client side
+(``parallel_sync``, peer.rs:921-1296) handshakes with N chosen peers,
+exchanges SyncStateV1 + HLC clocks, computes per-peer serveable needs,
+requests them, and feeds received changesets into ingestion; the server
+side (``serve_sync``, peer.rs:1308-1549) enforces a concurrency permit,
+answers needs by streaming chunked changesets read from the store
+(``handle_known_version``, peer.rs:350-667) with an adaptive chunk budget
+(8 KiB shrinking to 1 KiB when sends are slow, aborting at 5 s).
+
+Wire sequence on one bi stream:
+  client: bi_sync_start(actor_id, cluster_id)
+  client: sync state + clock              server: sync state + clock
+  client: request([needs])* ... request_fin
+  server: changeset* ... done
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import time
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+from ..agent.agent import Agent
+from ..agent.bookkeeping import Current, Partial
+from ..types.actor import ActorId
+from ..types.broadcast import ChangeSource, ChangesetEmpty, ChangesetFull, ChangeV1
+from ..types.change import MAX_CHANGES_BYTE_SIZE, Change, ChunkedChanges
+from ..types.clock import ClockDriftError
+from ..types.ranges import RangeSet
+from ..types.sync_state import SyncNeedFull, SyncNeedPartial, SyncStateV1
+from ..transport.net import FramedStream, Transport
+from .. import wire
+
+MAX_CONCURRENT_SYNCS = 3  # ref: agent.rs:131 sync permit semaphore
+ADAPTIVE_MIN_CHUNK = 1024  # ref: peer.rs adaptive floor 1 KiB
+SLOW_SEND_THRESHOLD = 0.5  # ref: peer.rs:641-654 (500 ms halves the budget)
+ABORT_SEND_THRESHOLD = 5.0  # ref: peer.rs abort >5 s
+HANDSHAKE_TIMEOUT = 2.0  # ref: peer.rs:982,992 (2 s state/clock timeouts)
+REQUEST_CHUNK = 10  # ref: peer.rs:1081 needs chunked in ranges of 10
+
+
+class SyncServer:
+    """Answers inbound sync sessions for one node."""
+
+    def __init__(self, agent: Agent, cluster_id: int = 0) -> None:
+        self.agent = agent
+        self.cluster_id = cluster_id
+        self._permits = asyncio.Semaphore(MAX_CONCURRENT_SYNCS)
+
+    async def serve(self, addr, fs: FramedStream) -> None:
+        """ref: serve_sync, peer.rs:1308-1549"""
+        first = await fs.recv(timeout=5.0)  # ref: bi.rs:62 5 s frame timeout
+        if first is None:
+            return
+        kind, payload = wire.decode_bi(first)
+        if kind != "sync_start":
+            return
+        peer_actor, peer_cluster, _trace = payload
+        if peer_cluster != self.cluster_id:
+            await fs.send(wire.encode_sync_rejection("different cluster"))
+            return
+        if self._permits.locked():
+            await fs.send(wire.encode_sync_rejection("max concurrency reached"))
+            return
+        async with self._permits:
+            # their state + clock
+            their_state: Optional[SyncStateV1] = None
+            for _ in range(2):
+                data = await fs.recv(timeout=HANDSHAKE_TIMEOUT)
+                if data is None:
+                    return
+                kind, payload = wire.decode_sync(data)
+                if kind == "state":
+                    their_state = payload
+                elif kind == "clock":
+                    with contextlib.suppress(ClockDriftError):
+                        self.agent.clock.update_with_timestamp(payload)
+            if their_state is None:
+                return
+            # our state + clock
+            await fs.send(wire.encode_sync_state(self.agent.generate_sync()))
+            await fs.send(
+                wire.encode_sync_clock(self.agent.clock.new_timestamp())
+            )
+            # requests until fin
+            while True:
+                data = await fs.recv(timeout=30.0)
+                if data is None:
+                    return
+                kind, payload = wire.decode_sync(data)
+                if kind == "request_fin":
+                    break
+                if kind != "request":
+                    continue
+                for actor_id, needs in payload:
+                    for need in needs:
+                        await self._serve_need(fs, actor_id, need)
+            await fs.send(wire.pack(("done",)))
+
+    async def _serve_need(self, fs: FramedStream, actor_id: ActorId, need) -> None:
+        """ref: process_sync → process_version → handle_known_version,
+        peer.rs:350-827"""
+        if isinstance(need, SyncNeedFull):
+            for version in range(need.versions[0], need.versions[1] + 1):
+                await self._serve_version(fs, actor_id, version, None)
+        elif isinstance(need, SyncNeedPartial):
+            await self._serve_version(fs, actor_id, need.version, list(need.seqs))
+
+
+    async def _serve_version(
+        self,
+        fs: FramedStream,
+        actor_id: ActorId,
+        version: int,
+        seqs_filter: Optional[List[Tuple[int, int]]],
+    ) -> None:
+        booked = self.agent.bookie.get(actor_id)
+        if booked is None:
+            return
+        async with booked.read(f"serve_sync:{actor_id.as_simple()}"):
+            known = booked.versions.get(version)
+        if known is None:
+            return
+
+        if isinstance(known, Current):
+            rows = await self.agent.pool.read_call(
+                lambda conn: conn.execute(
+                    f"SELECT {_CHANGE_COLS} FROM crsql_changes WHERE site_id = ? "
+                    "AND db_version = ? ORDER BY seq ASC",
+                    (actor_id, known.db_version),
+                ).fetchall()
+            )
+            changes = [_row_to_change(r) for r in rows]
+            await self._stream_chunks(
+                fs, actor_id, version, changes, known.last_seq, known.ts, seqs_filter
+            )
+        elif isinstance(known, Partial):
+            # serve what we have from the buffered-changes table
+            # (ref: peer.rs:424-559 partial serving mid-assembly).
+            # snapshot the seq set under the read lock: concurrent ingestion
+            # mutates the live Partial's RangeSet
+            async with booked.read(f"serve_sync:{actor_id.as_simple()}"):
+                seq_ranges = list(known.seqs)
+                last_seq = known.last_seq
+                ts = known.ts
+            known = Partial(
+                seqs=RangeSet(seq_ranges), last_seq=last_seq, ts=ts
+            )
+            rows = await self.agent.pool.read_call(
+                lambda conn: conn.execute(
+                    'SELECT "table", pk, cid, val, col_version, db_version, '
+                    "seq, site_id, cl FROM __corro_buffered_changes WHERE "
+                    "site_id = ? AND version = ? ORDER BY seq ASC",
+                    (actor_id, version),
+                ).fetchall()
+            )
+            changes = [_row_to_change(r) for r in rows]
+            for s, e in seq_ranges:
+                part = [c for c in changes if s <= c.seq <= e]
+                await self._stream_chunks(
+                    fs,
+                    actor_id,
+                    version,
+                    part,
+                    known.last_seq,
+                    known.ts,
+                    seqs_filter,
+                    cover=(s, e),
+                )
+        else:  # Cleared
+            await fs.send(
+                wire.encode_sync_changeset(
+                    ChangeV1(
+                        actor_id=actor_id,
+                        changeset=ChangesetEmpty(versions=(version, version)),
+                    )
+                )
+            )
+
+    async def _stream_chunks(
+        self,
+        fs: FramedStream,
+        actor_id: ActorId,
+        version: int,
+        changes: List[Change],
+        last_seq: int,
+        ts: int,
+        seqs_filter: Optional[List[Tuple[int, int]]],
+        cover: Optional[Tuple[int, int]] = None,
+    ) -> None:
+        """Adaptive chunked streaming (ref: send_change_chunks,
+        peer.rs:611-667)."""
+        if seqs_filter is not None:
+            changes = [
+                c
+                for c in changes
+                if any(s <= c.seq <= e for s, e in seqs_filter)
+            ]
+        start_seq, end_seq = cover if cover is not None else (0, last_seq)
+        chunker = ChunkedChanges(
+            changes, start_seq, end_seq, MAX_CHANGES_BYTE_SIZE
+        )
+        for chunk, seq_range in chunker:
+            t0 = time.monotonic()
+            await fs.send(
+                wire.encode_sync_changeset(
+                    ChangeV1(
+                        actor_id=actor_id,
+                        changeset=ChangesetFull(
+                            version=version,
+                            changes=tuple(chunk),
+                            seqs=seq_range,
+                            last_seq=last_seq,
+                            ts=ts,
+                        ),
+                    )
+                )
+            )
+            elapsed = time.monotonic() - t0
+            if elapsed > ABORT_SEND_THRESHOLD:
+                raise ConnectionError("sync send too slow, aborting")
+            if elapsed > SLOW_SEND_THRESHOLD:
+                chunker.max_buf_size = max(
+                    ADAPTIVE_MIN_CHUNK, chunker.max_buf_size // 2
+                )
+
+
+_CHANGE_COLS = '"table", pk, cid, val, col_version, db_version, seq, site_id, cl'
+
+
+def _row_to_change(r) -> Change:
+    return Change(
+        table=r[0],
+        pk=bytes(r[1]),
+        cid=r[2],
+        val=r[3],
+        col_version=r[4],
+        db_version=r[5],
+        seq=r[6],
+        site_id=bytes(r[7]),
+        cl=r[8],
+    )
+
+
+async def parallel_sync(
+    agent: Agent,
+    transport: Transport,
+    peers: List[Tuple[ActorId, Tuple[str, int]]],
+    submit: Callable[[ChangeV1, str], Awaitable[None]],
+    cluster_id: int = 0,
+) -> int:
+    """Sync with several peers at once (ref: parallel_sync,
+    peer.rs:921-1296).  Needs are deduplicated across peers: each peer gets
+    the portion of our needs it can serve that hasn't been claimed by an
+    earlier peer this round (ref: req_full/req_partials range sets,
+    peer.rs:1117-1120).  Returns changes received."""
+    our_state = agent.generate_sync()
+
+    async def handshake(actor_id, addr):
+        fs = await transport.open_bi(addr)
+        try:
+            await fs.send(
+                wire.encode_bi_sync_start(agent.actor_id, cluster_id)
+            )
+            await fs.send(wire.encode_sync_state(our_state))
+            await fs.send(wire.encode_sync_clock(agent.clock.new_timestamp()))
+            their_state = None
+            for _ in range(2):
+                data = await fs.recv(timeout=HANDSHAKE_TIMEOUT)
+                if data is None:
+                    raise ConnectionError("peer hung up during handshake")
+                kind, payload = wire.decode_sync(data)
+                if kind == "rejection":
+                    raise ConnectionError(f"sync rejected: {payload}")
+                if kind == "state":
+                    their_state = payload
+                elif kind == "clock":
+                    with contextlib.suppress(ClockDriftError):
+                        agent.clock.update_with_timestamp(payload)
+            return fs, their_state
+        except BaseException:
+            fs.close()
+            raise
+
+    # 1. handshake with everyone concurrently
+    handshakes = await asyncio.gather(
+        *(handshake(a, addr) for a, addr in peers), return_exceptions=True
+    )
+    sessions = []
+    for (actor_id, addr), hs in zip(peers, handshakes):
+        if isinstance(hs, BaseException):
+            continue
+        fs, their_state = hs
+        if their_state is None:
+            fs.close()
+            continue
+        sessions.append((actor_id, fs, their_state))
+
+    # 2. allocate needs across peers, dedup via claimed range sets
+    claimed_full: Dict[ActorId, RangeSet] = {}
+    claimed_partial: Dict[Tuple[ActorId, int], RangeSet] = {}
+    assignments: List[Tuple[FramedStream, Dict[ActorId, list]]] = []
+    for actor_id, fs, their_state in sessions:
+        serveable = our_state.compute_available_needs(their_state)
+        mine: Dict[ActorId, list] = {}
+        for origin, needs in serveable.items():
+            cf = claimed_full.setdefault(origin, RangeSet())
+            for need in needs:
+                if isinstance(need, SyncNeedFull):
+                    s, e = need.versions
+                    for gs, ge in list(cf.gaps(s, e)):
+                        mine.setdefault(origin, []).append(
+                            SyncNeedFull(versions=(gs, ge))
+                        )
+                        cf.insert(gs, ge)
+                else:
+                    cp = claimed_partial.setdefault(
+                        (origin, need.version), RangeSet()
+                    )
+                    unclaimed = []
+                    for s, e in need.seqs:
+                        unclaimed.extend(cp.gaps(s, e))
+                    if unclaimed:
+                        for s, e in unclaimed:
+                            cp.insert(s, e)
+                        mine.setdefault(origin, []).append(
+                            SyncNeedPartial(
+                                version=need.version, seqs=tuple(unclaimed)
+                            )
+                        )
+        assignments.append((fs, mine))
+
+    # 3. drive each session: send requests, ingest changesets until done
+    received = 0
+
+    async def drive(fs: FramedStream, mine: Dict[ActorId, list]) -> int:
+        count = 0
+        try:
+            reqs = [(a, needs) for a, needs in mine.items() if needs]
+            for i in range(0, len(reqs), REQUEST_CHUNK):
+                await fs.send(wire.encode_sync_request(reqs[i : i + REQUEST_CHUNK]))
+            await fs.send(wire.pack(("request_fin",)))
+            while True:
+                data = await fs.recv(timeout=30.0)
+                if data is None:
+                    break
+                kind, payload = wire.decode_sync(data)
+                if kind == "changeset":
+                    count += 1
+                    await submit(payload, ChangeSource.SYNC)
+                elif kind in ("done", "rejection"):
+                    break
+        finally:
+            fs.close()
+        return count
+
+    counts = await asyncio.gather(
+        *(drive(fs, mine) for fs, mine in assignments), return_exceptions=True
+    )
+    for c in counts:
+        if isinstance(c, int):
+            received += c
+    return received

@@ -1,0 +1,222 @@
+"""Datagram + stream transport.
+
+Equivalent of crates/corro-agent/src/transport.rs + the endpoint builders in
+api/peer.rs:103-324.  The reference multiplexes three channel classes over
+QUIC: unreliable datagrams (SWIM), uni streams (broadcasts), bi streams
+(sync sessions).  This transport keeps the same three-channel abstraction
+over UDP + TCP (the reference's ``gossip.plaintext`` mode is the spec;
+TLS/mTLS can wrap the TCP side via ssl contexts later):
+
+- ``send_datagram(addr, payload)``      — UDP, fire-and-forget (SWIM probes)
+- ``send_uni(addr, frames)``            — one-way framed stream, connection
+  cached per peer like the reference's connection cache (transport.rs:55-76)
+- ``open_bi(addr)``                     — bidirectional framed stream (sync)
+
+Stream protocol: 1 magic byte ('U' uni / 'B' bi) then u32-BE
+length-delimited frames (wire.frame).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import time
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+
+from ..wire import deframe, frame
+
+Addr = Tuple[str, int]
+
+UNI_MAGIC = b"U"
+BI_MAGIC = b"B"
+
+
+class FramedStream:
+    """Length-delimited frame reader/writer over an asyncio stream."""
+
+    def __init__(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self.reader = reader
+        self.writer = writer
+        self._buf = bytearray()
+
+    async def send(self, payload: bytes) -> None:
+        self.writer.write(frame(payload))
+        await self.writer.drain()
+
+    async def recv(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        """Next frame, or None on clean EOF."""
+        while True:
+            payload, consumed = deframe(memoryview(self._buf))
+            if payload is not None:
+                del self._buf[:consumed]
+                return payload
+            try:
+                chunk = await (
+                    asyncio.wait_for(self.reader.read(65536), timeout)
+                    if timeout is not None
+                    else self.reader.read(65536)
+                )
+            except asyncio.TimeoutError:
+                raise
+            if not chunk:
+                if self._buf:
+                    raise ConnectionError("stream ended mid-frame")
+                return None
+            self._buf += chunk
+
+    def close(self) -> None:
+        with contextlib.suppress(Exception):
+            self.writer.close()
+
+    async def wait_closed(self) -> None:
+        with contextlib.suppress(Exception):
+            await self.writer.wait_closed()
+
+
+class _Datagram(asyncio.DatagramProtocol):
+    def __init__(self, on_datagram: Callable[[Addr, bytes], None]) -> None:
+        self.on_datagram = on_datagram
+        self.transport: Optional[asyncio.DatagramTransport] = None
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self.on_datagram((addr[0], addr[1]), data)
+
+
+class Transport:
+    """One node's gossip endpoint: UDP + TCP server on the same port."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        on_datagram: Optional[Callable[[Addr, bytes], None]] = None,
+        on_uni_frame: Optional[Callable[[Addr, bytes], Awaitable[None]]] = None,
+        on_bi_stream: Optional[
+            Callable[[Addr, FramedStream], Awaitable[None]]
+        ] = None,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.on_datagram = on_datagram or (lambda a, d: None)
+        self.on_uni_frame = on_uni_frame
+        self.on_bi_stream = on_bi_stream
+        self._udp: Optional[asyncio.DatagramTransport] = None
+        self._tcp: Optional[asyncio.base_events.Server] = None
+        # cached outgoing uni connections per peer (ref: transport.rs:55-76)
+        self._uni_conns: Dict[Addr, FramedStream] = {}
+        self._uni_locks: Dict[Addr, asyncio.Lock] = {}
+        # live inbound streams, force-closed on stop so shutdown can't hang
+        # on handlers parked in recv()
+        self._inbound: set = set()
+        # rtt samples callback (ref: transport.rs:220 feeds members)
+        self.on_rtt: Optional[Callable[[Addr, float], None]] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> Addr:
+        loop = asyncio.get_running_loop()
+        self._udp, _proto = await loop.create_datagram_endpoint(
+            lambda: _Datagram(self._handle_datagram),
+            local_addr=(self.host, self.port),
+        )
+        udp_port = self._udp.get_extra_info("sockname")[1]
+        self._tcp = await asyncio.start_server(
+            self._handle_conn, self.host, udp_port
+        )
+        self.port = udp_port
+        return (self.host, self.port)
+
+    async def stop(self) -> None:
+        for fs in self._uni_conns.values():
+            fs.close()
+        self._uni_conns.clear()
+        for fs in list(self._inbound):
+            fs.close()
+        if self._udp is not None:
+            self._udp.close()
+            self._udp = None
+        if self._tcp is not None:
+            self._tcp.close()
+            # wait_closed (3.12) blocks until handlers exit; we closed their
+            # streams above, but guard with a timeout anyway
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._tcp.wait_closed(), 2.0)
+            self._tcp = None
+
+    def _handle_datagram(self, addr: Addr, data: bytes) -> None:
+        self.on_datagram(addr, data)
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        addr = (peer[0], peer[1]) if peer else ("?", 0)
+        try:
+            magic = await reader.readexactly(1)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            writer.close()
+            return
+        fs = FramedStream(reader, writer)
+        self._inbound.add(fs)
+        try:
+            if magic == UNI_MAGIC:
+                while True:
+                    payload = await fs.recv()
+                    if payload is None:
+                        break
+                    if self.on_uni_frame is not None:
+                        await self.on_uni_frame(addr, payload)
+            elif magic == BI_MAGIC:
+                if self.on_bi_stream is not None:
+                    await self.on_bi_stream(addr, fs)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            self._inbound.discard(fs)
+            fs.close()
+
+    # -- outgoing ---------------------------------------------------------
+
+    def send_datagram(self, addr: Addr, payload: bytes) -> None:
+        if self._udp is not None:
+            self._udp.sendto(payload, addr)
+
+    async def _connect_uni(self, addr: Addr) -> FramedStream:
+        t0 = time.monotonic()
+        reader, writer = await asyncio.open_connection(*addr)
+        if self.on_rtt is not None:
+            self.on_rtt(addr, (time.monotonic() - t0) * 1000.0)
+        writer.write(UNI_MAGIC)
+        fs = FramedStream(reader, writer)
+        self._uni_conns[addr] = fs
+        return fs
+
+    async def send_uni(self, addr: Addr, payload: bytes) -> None:
+        """Send one frame on the cached uni connection to addr, measuring
+        connect-time RTT for new connections."""
+        lock = self._uni_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            fs = self._uni_conns.get(addr)
+            if fs is None:
+                fs = await self._connect_uni(addr)
+            try:
+                await fs.send(payload)
+            except (ConnectionError, OSError):
+                # stale cached conn: drop it and retry once fresh
+                fs.close()
+                self._uni_conns.pop(addr, None)
+                fs = await self._connect_uni(addr)
+                await fs.send(payload)
+
+    async def open_bi(self, addr: Addr) -> FramedStream:
+        t0 = time.monotonic()
+        reader, writer = await asyncio.open_connection(*addr)
+        if self.on_rtt is not None:
+            self.on_rtt(addr, (time.monotonic() - t0) * 1000.0)
+        writer.write(BI_MAGIC)
+        return FramedStream(reader, writer)

@@ -1,0 +1,131 @@
+"""Configuration: TOML file + environment overrides.
+
+Equivalent of crates/corro-types/src/config.rs: sections db / api / gossip /
+perf / admin / telemetry (config.rs:35-54), loadable from TOML with
+``CORRO__``-prefixed env-var overrides using ``__`` as the section separator
+(config.rs:263-277), plus a builder-style constructor for tests
+(config.rs:279-402).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List, Optional
+
+ENV_PREFIX = "CORRO__"
+
+
+@dataclass
+class DbConfig:
+    path: str = "corrosion.db"
+    schema_paths: List[str] = field(default_factory=list)
+    read_conns: int = 4
+
+
+@dataclass
+class ApiConfig:
+    addr: str = "127.0.0.1:0"
+    authz_bearer: Optional[str] = None
+
+
+@dataclass
+class GossipConfig:
+    addr: str = "127.0.0.1:0"
+    bootstrap: List[str] = field(default_factory=list)
+    cluster_id: int = 0
+    plaintext: bool = True
+    max_transmissions: int = 15
+    probe_period: float = 1.0
+    probe_timeout: float = 0.5
+    suspicion_timeout: float = 3.0
+
+
+@dataclass
+class PerfConfig:
+    """Channel/queue tuning (ref: config.rs:160-201 PerfConfig)."""
+
+    apply_queue_len: int = 600
+    flush_interval: float = 0.05
+    sync_interval_min: float = 1.0
+    sync_interval_max: float = 15.0  # ref: MAX_SYNC_BACKOFF (agent/mod.rs:33)
+
+
+@dataclass
+class AdminConfig:
+    uds_path: Optional[str] = None
+
+
+@dataclass
+class TelemetryConfig:
+    prometheus_addr: Optional[str] = None
+
+
+@dataclass
+class Config:
+    db: DbConfig = field(default_factory=DbConfig)
+    api: ApiConfig = field(default_factory=ApiConfig)
+    gossip: GossipConfig = field(default_factory=GossipConfig)
+    perf: PerfConfig = field(default_factory=PerfConfig)
+    admin: AdminConfig = field(default_factory=AdminConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+
+    @staticmethod
+    def load(path: str) -> "Config":
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        return Config.from_dict(_apply_env_overrides(raw))
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "Config":
+        cfg = Config()
+        for section_field in fields(Config):
+            section = raw.get(section_field.name)
+            if not isinstance(section, dict):
+                continue
+            target = getattr(cfg, section_field.name)
+            for f in fields(target):
+                if f.name in section:
+                    setattr(target, f.name, section[f.name])
+        return cfg
+
+
+def _apply_env_overrides(raw: Dict[str, Any]) -> Dict[str, Any]:
+    """CORRO__SECTION__KEY=value overrides (ref: config.rs `__` separator)."""
+    for key, value in os.environ.items():
+        if not key.startswith(ENV_PREFIX):
+            continue
+        parts = key[len(ENV_PREFIX) :].lower().split("__")
+        if len(parts) != 2:
+            continue
+        section, name = parts
+        parsed: Any = value
+        if _is_list_field(section, name):
+            parsed = [v.strip() for v in value.split(",") if v.strip()]
+        elif value.isdigit():
+            parsed = int(value)
+        elif value.lower() in ("true", "false"):
+            parsed = value.lower() == "true"
+        else:
+            try:
+                parsed = float(value)
+            except ValueError:
+                parsed = value
+        raw.setdefault(section, {})[name] = parsed
+    return raw
+
+
+def _is_list_field(section: str, name: str) -> bool:
+    """List-typed config fields take comma-separated env values
+    (e.g. CORRO__GOSSIP__BOOTSTRAP=host1:8787,host2:8787)."""
+    defaults = Config()
+    target = getattr(defaults, section, None)
+    if target is None:
+        return False
+    return isinstance(getattr(target, name, None), list)
+
+
+def parse_addr(addr: str) -> tuple:
+    host, _, port = addr.rpartition(":")
+    return (host or "127.0.0.1", int(port))

@@ -1,0 +1,91 @@
+"""Cluster member table with RTT-bucketed rings.
+
+Equivalent of crates/corro-types/src/members.rs:36-170: members are sorted
+into rings by observed round-trip time; ring 0 (lowest RTT) gets immediate
+broadcasts, the rest are sampled (broadcast/mod.rs:488-547).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .actor import Actor, ActorId
+
+# ring upper bounds in ms (6 rings, ref: members.rs RTT ring buckets)
+RING_BOUNDS_MS = [10.0, 50.0, 100.0, 200.0, 300.0, float("inf")]
+MAX_RTTS = 20
+
+
+@dataclass
+class MemberState:
+    actor: Actor
+    state: str = "up"  # up | down
+    rtts: List[float] = field(default_factory=list)
+    ring: Optional[int] = None
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return self.actor.addr
+
+    def rtt_min(self) -> Optional[float]:
+        return min(self.rtts) if self.rtts else None
+
+
+class Members:
+    """Membership registry (ref: members.rs Members)."""
+
+    def __init__(self, our_actor_id: ActorId) -> None:
+        self.our_actor_id = our_actor_id
+        self.states: Dict[ActorId, MemberState] = {}
+
+    def add_member(self, actor: Actor) -> bool:
+        """Returns True when this is a new/updated up member."""
+        if actor.id == self.our_actor_id:
+            return False
+        existing = self.states.get(actor.id)
+        if existing is None:
+            self.states[actor.id] = MemberState(actor=actor)
+            return True
+        newer = actor.ts >= existing.actor.ts
+        if newer:
+            was_down = existing.state == "down"
+            existing.actor = actor
+            existing.state = "up"
+            return was_down
+        return False
+
+    def remove_member(self, actor: Actor) -> bool:
+        """Mark down (keep RTT history). True when state changed."""
+        existing = self.states.get(actor.id)
+        if existing is None or existing.state == "down":
+            return False
+        if actor.ts < existing.actor.ts:
+            return False  # stale down notice for an older incarnation
+        existing.state = "down"
+        return True
+
+    def add_rtt(self, actor_id: ActorId, rtt_ms: float) -> None:
+        """Record an RTT sample and re-bucket (ref: members.rs:122-170)."""
+        st = self.states.get(actor_id)
+        if st is None:
+            return
+        st.rtts.append(rtt_ms)
+        if len(st.rtts) > MAX_RTTS:
+            st.rtts.pop(0)
+        lo = st.rtt_min()
+        for ring, bound in enumerate(RING_BOUNDS_MS):
+            if lo <= bound:
+                st.ring = ring
+                break
+
+    def get(self, actor_id: ActorId) -> Optional[MemberState]:
+        return self.states.get(actor_id)
+
+    def up_members(self) -> List[MemberState]:
+        return [m for m in self.states.values() if m.state == "up"]
+
+    def ring0(self) -> List[MemberState]:
+        """Lowest-RTT members — immediate broadcast targets
+        (ref: members.rs ring0())."""
+        return [m for m in self.up_members() if m.ring == 0]

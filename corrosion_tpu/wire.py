@@ -1,0 +1,306 @@
+"""Wire serialization: length-delimited frames + msgpack-encoded messages.
+
+Equivalent of the reference's `speedy` encoding + ``LengthDelimitedCodec``
+framing (corro-types/src/sync.rs:353-369, api/peer.rs:839-852).  Every peer
+message is a tagged tuple encoded with msgpack (compact, zero-copy bytes)
+inside a u32-BE length-delimited frame.
+
+Message model (mirrors corro-types/src/broadcast.rs:30-124):
+
+- ``UniPayload``: broadcast stream payloads — ("bcast", ChangeV1, rebroadcast?)
+- ``BiPayload``:  sync stream openers — ("sync_start", actor_id, cluster_id)
+- ``SyncMessage``: state/changeset/clock/rejection/request exchanges
+- ``SwimMessage``: SWIM probe traffic (datagrams)
+
+All encoders produce plain tuples so the codec stays declarative; decoding
+validates shape and rebuilds the dataclasses.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
+
+from .types.actor import Actor, ActorId
+from .types.broadcast import (
+    ChangeV1,
+    Changeset,
+    ChangesetEmpty,
+    ChangesetFull,
+)
+from .types.change import Change
+from .types.sync_state import (
+    SyncNeedFull,
+    SyncNeedPartial,
+    SyncStateV1,
+)
+
+MAX_FRAME = 32 * 1024 * 1024
+
+
+class WireError(Exception):
+    pass
+
+
+def _decoder(fn):
+    """Any malformed-shape failure inside a decoder becomes WireError, so
+    transport handlers have one exception type for bad peer input."""
+
+    def wrapped(data):
+        try:
+            return fn(data)
+        except WireError:
+            raise
+        except (TypeError, IndexError, KeyError, ValueError) as e:
+            raise WireError(f"malformed {fn.__name__} payload: {e}") from e
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def frame(payload: bytes) -> bytes:
+    return struct.pack(">I", len(payload)) + payload
+
+
+def deframe(buf: memoryview) -> Tuple[Optional[bytes], int]:
+    """Try to extract one frame; returns (payload | None, bytes_consumed)."""
+    if len(buf) < 4:
+        return None, 0
+    (n,) = struct.unpack_from(">I", buf, 0)
+    if n > MAX_FRAME:
+        raise WireError(f"frame of {n} bytes exceeds max {MAX_FRAME}")
+    if len(buf) < 4 + n:
+        return None, 0
+    return bytes(buf[4 : 4 + n]), 4 + n
+
+
+def pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes) -> Any:
+    try:
+        return msgpack.unpackb(data, raw=False, strict_map_key=False)
+    except Exception as e:  # malformed peer input must become WireError
+        raise WireError(f"malformed message: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# changesets
+# ---------------------------------------------------------------------------
+
+
+def change_to_tuple(ch: Change) -> tuple:
+    return (
+        ch.table,
+        ch.pk,
+        ch.cid,
+        ch.val,
+        ch.col_version,
+        ch.db_version,
+        ch.seq,
+        ch.site_id,
+        ch.cl,
+    )
+
+
+def change_from_tuple(t: list) -> Change:
+    return Change(
+        table=t[0],
+        pk=t[1],
+        cid=t[2],
+        val=t[3],
+        col_version=t[4],
+        db_version=t[5],
+        seq=t[6],
+        site_id=t[7],
+        cl=t[8],
+    )
+
+
+def changeset_to_obj(cs: Changeset) -> tuple:
+    if isinstance(cs, ChangesetEmpty):
+        return ("empty", list(cs.versions), cs.ts)
+    return (
+        "full",
+        cs.version,
+        [change_to_tuple(c) for c in cs.changes],
+        list(cs.seqs),
+        cs.last_seq,
+        cs.ts,
+    )
+
+
+def changeset_from_obj(o: list) -> Changeset:
+    if o[0] == "empty":
+        return ChangesetEmpty(versions=tuple(o[1]), ts=o[2])
+    if o[0] == "full":
+        return ChangesetFull(
+            version=o[1],
+            changes=tuple(change_from_tuple(c) for c in o[2]),
+            seqs=tuple(o[3]),
+            last_seq=o[4],
+            ts=o[5],
+        )
+    raise WireError(f"bad changeset tag {o[0]!r}")
+
+
+def change_v1_to_obj(cv: ChangeV1) -> tuple:
+    return (bytes(cv.actor_id), changeset_to_obj(cv.changeset))
+
+
+def change_v1_from_obj(o: list) -> ChangeV1:
+    return ChangeV1(actor_id=ActorId(o[0]), changeset=changeset_from_obj(o[1]))
+
+
+# ---------------------------------------------------------------------------
+# sync state
+# ---------------------------------------------------------------------------
+
+
+def sync_state_to_obj(st: SyncStateV1) -> tuple:
+    return (
+        bytes(st.actor_id),
+        {bytes(a): h for a, h in st.heads.items()},
+        {bytes(a): [list(r) for r in v] for a, v in st.need.items()},
+        {
+            bytes(a): {v: [list(r) for r in seqs] for v, seqs in pn.items()}
+            for a, pn in st.partial_need.items()
+        },
+    )
+
+
+def sync_state_from_obj(o: list) -> SyncStateV1:
+    st = SyncStateV1(actor_id=ActorId(o[0]))
+    st.heads = {ActorId(a): h for a, h in o[1].items()}
+    st.need = {ActorId(a): [tuple(r) for r in v] for a, v in o[2].items()}
+    st.partial_need = {
+        ActorId(a): {int(v): [tuple(r) for r in seqs] for v, seqs in pn.items()}
+        for a, pn in o[3].items()
+    }
+    return st
+
+
+def need_to_obj(need) -> tuple:
+    if isinstance(need, SyncNeedFull):
+        return ("full", list(need.versions))
+    return ("partial", need.version, [list(r) for r in need.seqs])
+
+
+def need_from_obj(o: list):
+    if o[0] == "full":
+        return SyncNeedFull(versions=tuple(o[1]))
+    if o[0] == "partial":
+        return SyncNeedPartial(version=o[1], seqs=tuple(tuple(r) for r in o[2]))
+    raise WireError(f"bad need tag {o[0]!r}")
+
+
+# ---------------------------------------------------------------------------
+# top-level payloads
+# ---------------------------------------------------------------------------
+
+
+def encode_uni_broadcast(cv: ChangeV1, cluster_id: int, rebroadcast: bool) -> bytes:
+    """UniPayload::V1::Broadcast (ref: broadcast.rs UniPayload)."""
+    return pack(("bcast", change_v1_to_obj(cv), cluster_id, rebroadcast))
+
+
+@_decoder
+def decode_uni(data: bytes) -> Tuple[str, Any]:
+    o = unpack(data)
+    if o[0] == "bcast":
+        return ("bcast", (change_v1_from_obj(o[1]), o[2], bool(o[3])))
+    raise WireError(f"bad uni payload {o[0]!r}")
+
+
+def encode_bi_sync_start(actor_id: ActorId, cluster_id: int, trace: Optional[dict] = None) -> bytes:
+    """BiPayload::V1::SyncStart — carries the trace context like the
+    reference's SyncTraceContextV1 (sync.rs:32-67)."""
+    return pack(("sync_start", bytes(actor_id), cluster_id, trace or {}))
+
+
+@_decoder
+def decode_bi(data: bytes) -> Tuple[str, Any]:
+    o = unpack(data)
+    if o[0] == "sync_start":
+        return ("sync_start", (ActorId(o[1]), o[2], o[3]))
+    raise WireError(f"bad bi payload {o[0]!r}")
+
+
+# SyncMessage variants (ref: sync.rs:18-30)
+
+
+def encode_sync_state(st: SyncStateV1) -> bytes:
+    return pack(("state", sync_state_to_obj(st)))
+
+
+def encode_sync_clock(ts: int) -> bytes:
+    return pack(("clock", ts))
+
+
+def encode_sync_changeset(cv: ChangeV1) -> bytes:
+    return pack(("changeset", change_v1_to_obj(cv)))
+
+
+def encode_sync_rejection(reason: str) -> bytes:
+    return pack(("rejection", reason))
+
+
+def encode_sync_request(req: List[Tuple[ActorId, List[Any]]]) -> bytes:
+    return pack(
+        ("request", [(bytes(a), [need_to_obj(n) for n in needs]) for a, needs in req])
+    )
+
+
+@_decoder
+def decode_sync(data: bytes) -> Tuple[str, Any]:
+    o = unpack(data)
+    tag = o[0]
+    if tag == "state":
+        return ("state", sync_state_from_obj(o[1]))
+    if tag == "clock":
+        return ("clock", o[1])
+    if tag == "changeset":
+        return ("changeset", change_v1_from_obj(o[1]))
+    if tag == "rejection":
+        return ("rejection", o[1])
+    if tag == "request":
+        return (
+            "request",
+            [(ActorId(a), [need_from_obj(n) for n in needs]) for a, needs in o[1]],
+        )
+    if tag in ("request_fin", "done"):
+        return (tag, None)
+    raise WireError(f"bad sync message {tag!r}")
+
+
+# ---------------------------------------------------------------------------
+# SWIM datagrams
+# ---------------------------------------------------------------------------
+
+
+def actor_to_obj(a: Actor) -> tuple:
+    return (bytes(a.id), list(a.addr), a.ts, a.cluster_id)
+
+
+def actor_from_obj(o: list) -> Actor:
+    return Actor(id=ActorId(o[0]), addr=(o[1][0], o[1][1]), ts=o[2], cluster_id=o[3])
+
+
+def encode_swim(msg: tuple) -> bytes:
+    """SWIM messages are already tuple-shaped (see swim/core.py)."""
+    return pack(("swim",) + msg)
+
+
+@_decoder
+def decode_swim(data: bytes) -> tuple:
+    o = unpack(data)
+    if o[0] != "swim":
+        raise WireError(f"not a swim message: {o[0]!r}")
+    return tuple(o[1:])

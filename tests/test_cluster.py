@@ -1,0 +1,193 @@
+"""Multi-node integration tests over real loopback sockets.
+
+Gate for SURVEY.md §7 step 6: port of `insert_rows_and_gossip`
+(crates/corro-agent/src/agent/tests.rs:31-258) — two full nodes, write via
+HTTP on node 1, assert replicated rows + bookkeeping on node 2 — and a
+late-joiner anti-entropy catch-up.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import ClientSession
+
+from corrosion_tpu.agent.node import Node
+from corrosion_tpu.types.config import Config
+
+SCHEMA = (
+    'CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;'
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def boot_node(bootstrap=(), schema=SCHEMA, **gossip_overrides) -> Node:
+    cfg = Config()
+    cfg.db.path = ":memory:"
+    cfg.gossip.bootstrap = list(bootstrap)
+    cfg.gossip.probe_period = 0.3
+    cfg.gossip.probe_timeout = 0.15
+    cfg.gossip.suspicion_timeout = 1.0
+    cfg.perf.sync_interval_min = 0.3
+    cfg.perf.sync_interval_max = 1.0
+    for k, v in gossip_overrides.items():
+        setattr(cfg.gossip, k, v)
+    node = await Node(cfg).start()
+    if schema:
+        await node.agent.pool.write_call(
+            lambda c: __import__(
+                "corrosion_tpu.types.schema", fromlist=["apply_schema"]
+            ).apply_schema(c, schema)
+        )
+    return node
+
+
+async def wait_for(predicate, timeout=10.0, interval=0.1, msg="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        result = await predicate()
+        if result:
+            return result
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError(f"timed out waiting for {msg}")
+        await asyncio.sleep(interval)
+
+
+def test_insert_rows_and_gossip():
+    async def main():
+        n1 = await boot_node()
+        n2 = await boot_node(bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"])
+        try:
+            async with ClientSession() as http:
+                r = await http.post(
+                    f"{n1.api_base}/v1/transactions",
+                    json=[["INSERT INTO tests (id,text) VALUES (?,?)", [1, "hello world 1"]]],
+                )
+                assert r.status == 200
+                body = await r.json()
+                assert body["version"] == 1
+
+                # replicated to node 2 via gossip
+                async def replicated():
+                    rows = await n2.agent.pool.read_call(
+                        lambda c: c.execute(
+                            "SELECT id, text FROM tests WHERE id = 1"
+                        ).fetchall()
+                    )
+                    return rows == [(1, "hello world 1")]
+
+                await wait_for(replicated, msg="row replicated to n2")
+
+                # second write
+                r = await http.post(
+                    f"{n1.api_base}/v1/transactions",
+                    json=[["INSERT INTO tests (id,text) VALUES (?,?)", [2, "hello world 2"]]],
+                )
+                assert (await r.json())["version"] == 2
+
+                async def second():
+                    rows = await n2.agent.pool.read_call(
+                        lambda c: c.execute("SELECT COUNT(*) FROM tests").fetchone()
+                    )
+                    return rows == (2,)
+
+                await wait_for(second, msg="second row replicated")
+
+                # bookkeeping on node 2 mirrors node 1's versions
+                # (ref: tests.rs:137-166 exact __corro_bookkeeping assertions)
+                rows = await n2.agent.pool.read_call(
+                    lambda c: c.execute(
+                        "SELECT actor_id, start_version, end_version, last_seq "
+                        "FROM __corro_bookkeeping ORDER BY start_version"
+                    ).fetchall()
+                )
+                assert [(bytes(r[0]), r[1], r[2], r[3]) for r in rows] == [
+                    (bytes(n1.agent.actor_id), 1, None, 0),
+                    (bytes(n1.agent.actor_id), 2, None, 0),
+                ]
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())
+
+
+def test_late_joiner_catches_up_via_sync():
+    async def main():
+        n1 = await boot_node()
+        try:
+            async with ClientSession() as http:
+                for i in range(20):
+                    r = await http.post(
+                        f"{n1.api_base}/v1/transactions",
+                        json=[["INSERT INTO tests (id,text) VALUES (?,?)", [i, f"v{i}"]]],
+                    )
+                    assert r.status == 200
+            # n2 joins AFTER all writes happened: broadcast can't help, only
+            # anti-entropy sync can
+            n2 = await boot_node(bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"])
+            try:
+
+                async def caught_up():
+                    rows = await n2.agent.pool.read_call(
+                        lambda c: c.execute("SELECT COUNT(*) FROM tests").fetchone()
+                    )
+                    return rows == (20,)
+
+                await wait_for(caught_up, timeout=15.0, msg="late joiner sync")
+                state = n2.agent.generate_sync()
+                assert state.need_len() == 0
+            finally:
+                await n2.stop()
+        finally:
+            await n1.stop()
+
+    run(main())
+
+
+def test_three_nodes_converge():
+    async def main():
+        n1 = await boot_node()
+        n2 = await boot_node(bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"])
+        n3 = await boot_node(bootstrap=[f"127.0.0.1:{n2.gossip_addr[1]}"])
+        nodes = [n1, n2, n3]
+        try:
+            async with ClientSession() as http:
+                # writes sprayed across nodes
+                for i, node in enumerate(nodes * 4):
+                    r = await http.post(
+                        f"{node.api_base}/v1/transactions",
+                        json=[[
+                            "INSERT INTO tests (id,text) VALUES (?,?) "
+                            "ON CONFLICT (id) DO UPDATE SET text = excluded.text",
+                            [i, f"from-{node.agent.actor_id.as_simple()[:6]}"],
+                        ]],
+                    )
+                    assert r.status == 200
+
+            async def converged():
+                dumps = []
+                for node in nodes:
+                    rows = await node.agent.pool.read_call(
+                        lambda c: c.execute(
+                            "SELECT id, text FROM tests ORDER BY id"
+                        ).fetchall()
+                    )
+                    dumps.append(rows)
+                if not all(d == dumps[0] for d in dumps):
+                    return False
+                # the reference's convergence bar: all rows everywhere AND
+                # need_len()==0 on every node (tests.rs:464-476)
+                return all(
+                    n.agent.generate_sync().need_len() == 0 for n in nodes
+                )
+
+            await wait_for(converged, timeout=20.0, msg="3-node convergence")
+        finally:
+            for node in reversed(nodes):
+                await node.stop()
+
+    run(main())

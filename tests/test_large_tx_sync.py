@@ -1,0 +1,83 @@
+"""Port of `large_tx_sync` (crates/corro-agent/src/agent/tests.rs:605-731):
+one node commits many rows across several transactions — including one
+large version that must chunk and buffer — then fresh nodes chain-bootstrap
+and reach the full row count via anti-entropy sync alone (no broadcasts:
+the writes happen before the joiners exist).  Scaled from the reference's
+65k rows to stay fast in CI; the structure (multi-chunk version + chained
+bootstrap) is preserved.
+"""
+
+import asyncio
+
+from aiohttp import ClientSession
+
+from tests.test_cluster import SCHEMA, boot_node, wait_for
+
+TOTAL_ROWS = 1200
+BIG_TX_ROWS = 800  # one version large enough for many 8 KiB chunks
+
+
+def test_large_tx_sync():
+    async def main():
+        n1 = await boot_node()
+        try:
+            async with ClientSession() as http:
+                # one big multi-chunk version
+                stmts = [
+                    ["INSERT INTO tests (id,text) VALUES (?,?)", [i, f"big{i:06d}" * 4]]
+                    for i in range(BIG_TX_ROWS)
+                ]
+                r = await http.post(f"{n1.api_base}/v1/transactions", json=stmts)
+                assert r.status == 200, await r.text()
+                # then many small versions
+                for i in range(BIG_TX_ROWS, TOTAL_ROWS, 100):
+                    stmts = [
+                        ["INSERT INTO tests (id,text) VALUES (?,?)", [j, f"v{j}"]]
+                        for j in range(i, min(i + 100, TOTAL_ROWS))
+                    ]
+                    r = await http.post(f"{n1.api_base}/v1/transactions", json=stmts)
+                    assert r.status == 200
+
+            # the big version really was chunked
+            big = n1.agent.bookie.get(n1.agent.actor_id).versions.current[1]
+            assert big.last_seq == BIG_TX_ROWS - 1
+
+            # chain bootstrap: n2 -> n1, n3 -> n2, n4 -> n3
+            n2 = await boot_node(bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"])
+            n3 = await boot_node(bootstrap=[f"127.0.0.1:{n2.gossip_addr[1]}"])
+            n4 = await boot_node(bootstrap=[f"127.0.0.1:{n3.gossip_addr[1]}"])
+            joiners = [n2, n3, n4]
+            try:
+
+                async def all_synced():
+                    for n in joiners:
+                        rows = await n.agent.pool.read_call(
+                            lambda c: c.execute(
+                                "SELECT COUNT(*) FROM tests"
+                            ).fetchone()
+                        )
+                        if rows != (TOTAL_ROWS,):
+                            return False
+                    return all(
+                        n.agent.generate_sync().need_len() == 0 for n in joiners
+                    )
+
+                await wait_for(all_synced, timeout=60.0, msg="chained large sync")
+
+                # no leftover buffering anywhere (ref: tests.rs:713-719
+                # buffered-change asserts on failure)
+                for n in joiners:
+                    leftovers = await n.agent.pool.read_call(
+                        lambda c: c.execute(
+                            "SELECT (SELECT COUNT(*) FROM __corro_buffered_changes), "
+                            "(SELECT COUNT(*) FROM __corro_seq_bookkeeping)"
+                        ).fetchone()
+                    )
+                    assert leftovers == (0, 0)
+            finally:
+                for n in reversed(joiners):
+                    await n.stop()
+        finally:
+            await n1.stop()
+
+    asyncio.run(main())

@@ -1,0 +1,188 @@
+"""SWIM core tests with virtual time and an in-memory datagram network —
+no sockets, no sleeps (gate for SURVEY.md §7 step 5; improves on the
+reference's real-socket-only test strategy, SURVEY §4)."""
+
+import random
+
+from corrosion_tpu.swim.core import ALIVE, DOWN, SUSPECT, Swim, SwimConfig
+from corrosion_tpu.types.actor import Actor, ActorId
+
+
+class VirtualNet:
+    """Delivers SWIM outputs between cores by address, with a drop set."""
+
+    def __init__(self, cfg=None, seed=1):
+        self.cfg = cfg or SwimConfig()
+        self.rng = random.Random(seed)
+        self.nodes = {}  # addr -> Swim
+        self.partitioned = set()  # addrs that drop all traffic
+        self.events = []  # (addr, actor, what)
+
+    def add(self, port):
+        addr = ("127.0.0.1", port)
+        actor = Actor(id=ActorId.random(), addr=addr, ts=1)
+        swim = Swim(
+            actor, self.cfg, rng=random.Random(self.rng.randrange(1 << 30)), now=0.0
+        )
+        self.nodes[addr] = swim
+        return swim
+
+    def run(self, until, dt=0.1, start=0.0):
+        now = start
+        while now < until:
+            for swim in self.nodes.values():
+                swim.tick(now)
+            # route until quiescent this step
+            for _ in range(10):
+                moved = False
+                for addr, swim in self.nodes.items():
+                    if addr in self.partitioned:
+                        swim.take_outputs()
+                        continue
+                    for dest, msg in swim.take_outputs():
+                        moved = True
+                        if dest in self.partitioned:
+                            continue
+                        target = self.nodes.get(dest)
+                        if target is not None:
+                            target.handle(msg, now)
+                for addr, swim in self.nodes.items():
+                    for actor, what in swim.take_events():
+                        self.events.append((addr, actor, what))
+                if not moved:
+                    break
+            now += dt
+        return now
+
+
+def test_three_node_join():
+    net = VirtualNet()
+    a, b, c = net.add(1), net.add(2), net.add(3)
+    b.announce(a.identity.addr)
+    c.announce(a.identity.addr)
+    net.run(until=5.0)
+    for swim in (a, b, c):
+        assert len(swim.up_members()) == 2, swim.identity
+    ups = [(e[1].id, e[2]) for e in net.events if e[2] == "up"]
+    assert len(ups) >= 4  # every node saw the other two come up
+
+
+def test_failure_detection_and_suspicion():
+    cfg = SwimConfig(probe_period=0.5, probe_timeout=0.2, suspicion_timeout=1.5)
+    net = VirtualNet(cfg)
+    a, b, c = net.add(1), net.add(2), net.add(3)
+    b.announce(a.identity.addr)
+    c.announce(a.identity.addr)
+    net.run(until=3.0)
+    # kill b: drop all its traffic
+    net.partitioned.add(b.identity.addr)
+    end = net.run(until=15.0, start=3.0)
+    for swim in (a, c):
+        entry = swim.members[b.identity.id]
+        assert entry.state == DOWN, (swim.identity, entry.state)
+    downs = {(e[0], e[2]) for e in net.events if e[2] == "down"}
+    assert (a.identity.addr, "down") in downs
+    assert (c.identity.addr, "down") in downs
+
+
+def test_rejoin_with_renewed_identity():
+    cfg = SwimConfig(probe_period=0.5, probe_timeout=0.2, suspicion_timeout=1.0)
+    net = VirtualNet(cfg)
+    a, b = net.add(1), net.add(2)
+    b.announce(a.identity.addr)
+    net.run(until=2.0)
+    net.partitioned.add(b.identity.addr)
+    net.run(until=10.0, start=2.0)
+    assert a.members[b.identity.id].state == DOWN
+
+    # b comes back with a renewed identity (ref: actor.rs renew())
+    del net.nodes[b.identity.addr]
+    net.partitioned.discard(b.identity.addr)
+    b2 = Swim(
+        b.identity.renew(ts=2), cfg, rng=random.Random(99), now=10.0
+    )
+    net.nodes[b2.identity.addr] = b2
+    b2.announce(a.identity.addr)
+    net.run(until=13.0, start=10.0)
+    assert a.members[b2.identity.id].state == ALIVE
+    ups = [e for e in net.events if e[0] == a.identity.addr and e[2] == "up"]
+    assert len(ups) >= 2  # initial join + rejoin
+
+
+def test_refutation_of_false_suspicion():
+    cfg = SwimConfig(probe_period=0.5, probe_timeout=0.2, suspicion_timeout=5.0)
+    net = VirtualNet(cfg)
+    a, b = net.add(1), net.add(2)
+    b.announce(a.identity.addr)
+    net.run(until=2.0)
+    # a wrongly suspects b via a forged piggyback observation
+    a._apply_piggyback(
+        [[list(__import__("corrosion_tpu.wire", fromlist=["actor_to_obj"]).actor_to_obj(b.identity)), SUSPECT, 0]],
+        2.0,
+    )
+    assert a.members[b.identity.id].state == SUSPECT
+    # keep gossiping: b sees the suspicion, bumps incarnation, refutes
+    net.run(until=6.0, start=2.0)
+    assert a.members[b.identity.id].state == ALIVE
+    assert b.incarnation >= 1
+
+
+def test_graceful_leave():
+    net = VirtualNet()
+    a, b = net.add(1), net.add(2)
+    b.announce(a.identity.addr)
+    net.run(until=2.0)
+    b.leave()
+    net.run(until=3.0, start=2.0)
+    assert a.members[b.identity.id].state == DOWN
+
+
+def test_partition_heal_revives_down_members():
+    """After a full partition both sides mark each other DOWN; once healed,
+    direct contact (announce) must revive the entries without waiting for
+    identity renewal or the 48h removal."""
+    cfg = SwimConfig(probe_period=0.3, probe_timeout=0.1, suspicion_timeout=0.8)
+    net = VirtualNet(cfg)
+    a, b = net.add(1), net.add(2)
+    b.announce(a.identity.addr)
+    net.run(until=2.0)
+    net.partitioned.add(b.identity.addr)
+    net.run(until=8.0, start=2.0)
+    assert a.members[b.identity.id].state == DOWN
+    assert b.members[a.identity.id].state == DOWN
+    # heal: b's isolation announce loop fires again (same identity, no renew)
+    net.partitioned.discard(b.identity.addr)
+    b.announce(a.identity.addr)
+    net.run(until=12.0, start=8.0)
+    assert a.members[b.identity.id].state == ALIVE
+    assert b.members[a.identity.id].state == ALIVE
+
+
+def test_stale_down_update_cannot_kill_rejoined_node():
+    """A queued 'down' update about an OLD identity must not take down the
+    rejoined newer identity (stale-ts guard in _observe_down)."""
+    net = VirtualNet()
+    a, b = net.add(1), net.add(2)
+    b.announce(a.identity.addr)
+    net.run(until=2.0)
+    from corrosion_tpu.wire import actor_to_obj
+
+    old_identity = b.identity  # ts=1
+    renewed = b.identity.renew(ts=5)
+    a._observe_alive(renewed, 0, 2.0)
+    assert a.members[b.identity.id].actor.ts == 5
+    # stale down gossip about ts=1 arrives late
+    a._apply_piggyback([[list(actor_to_obj(old_identity)), DOWN, 0]], 2.1)
+    assert a.members[b.identity.id].state == ALIVE
+
+
+def test_larger_cluster_converges_membership():
+    cfg = SwimConfig(probe_period=0.3, probe_timeout=0.1)
+    net = VirtualNet(cfg, seed=42)
+    nodes = [net.add(i) for i in range(1, 16)]
+    # chain bootstrap: everyone announces to node 1
+    for n in nodes[1:]:
+        n.announce(nodes[0].identity.addr)
+    net.run(until=10.0)
+    for swim in nodes:
+        assert len(swim.up_members()) == 14, swim.identity

@@ -32,12 +32,16 @@ class ChangeIngest:
         agent: Agent,
         rebroadcast: Optional[Callable] = None,
         notify: Optional[Callable] = None,
+        apply_queue_len: int = APPLY_QUEUE_LEN,
+        flush_interval: float = FLUSH_INTERVAL,
     ) -> None:
         self.agent = agent
         # async callback(list[ChangeV1]) -> None, fans back out
         self.rebroadcast = rebroadcast
         # async callback(list[(actor_id, Changeset)]) — subscription matching
         self.notify = notify
+        self.apply_queue_len = apply_queue_len
+        self.flush_interval = flush_interval
         self.queue: asyncio.Queue = asyncio.Queue()
         self._seen: "OrderedDict[tuple, None]" = OrderedDict()
         self._task: Optional[asyncio.Task] = None
@@ -70,8 +74,8 @@ class ChangeIngest:
     async def _run(self) -> None:
         while True:
             batch: List[Tuple[ChangeV1, str]] = [await self.queue.get()]
-            deadline = asyncio.get_running_loop().time() + FLUSH_INTERVAL
-            while len(batch) < APPLY_QUEUE_LEN:
+            deadline = asyncio.get_running_loop().time() + self.flush_interval
+            while len(batch) < self.apply_queue_len:
                 timeout = deadline - asyncio.get_running_loop().time()
                 if timeout <= 0:
                     break

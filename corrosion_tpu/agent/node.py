@@ -111,6 +111,8 @@ class Node:
             rebroadcast=lambda changes: self.broadcast.enqueue(
                 changes, rebroadcast=True
             ),
+            apply_queue_len=self.config.perf.apply_queue_len,
+            flush_interval=self.config.perf.flush_interval,
         )
         self.api = Api(
             self.agent,

@@ -1,0 +1,17 @@
+"""TPU simulation/analysis backend.
+
+Reframes the Corrosion dissemination + anti-entropy loop (SURVEY.md §5/§7)
+as batched sparse graph message-passing in JAX: node state as dense
+tensors, one gossip round per `lax.while_loop`/`lax.scan` step,
+fanout/sync as scatter-max/gather, sharded over a device mesh.
+
+- rng:       counter-based PRNG, bit-identical Python/JAX streams
+- model:     round-synchronous cluster model + BASELINE configs 1-5
+- reference: pure-Python per-node CPU reference simulator
+- cluster:   vectorized JAX simulator (the TPU compute path)
+- crdt:      vectorized LWW/causal-length merge analysis
+"""
+
+from .model import CONFIGS, SimParams  # noqa: F401
+from .cluster import SimResult, init_state, make_step, run, run_trace  # noqa: F401
+from .reference import RefResult, run_reference  # noqa: F401

@@ -1,0 +1,227 @@
+"""Vectorized TPU cluster simulator (JAX).
+
+The whole cluster is one tensor program: node state is a pair of arrays
+
+  ``have``   bool[N, K]   node n holds changeset k
+  ``budget`` int8[N, K]   remaining retransmissions (broadcast send_count,
+                          ref: PendingBroadcast, broadcast/mod.rs:747-773)
+
+and one gossip round (sim/model.py's round model) is one pure ``step``
+suitable for ``lax.while_loop`` / ``lax.scan``.  Dissemination is
+edge-scatter: each fanout slot is a row-scatter ``delivered.at[t].max(pay)``
+(duplicate targets OR-combine), anti-entropy is a row-gather
+``have[q]``.  All randomness is the counter-based integer hash of
+sim/rng.py, bit-identical to the CPU reference (sim/reference.py), so
+round counts agree exactly.
+
+Scaling: shard the node axis across a ``jax.sharding.Mesh`` —
+``run(p, mesh=...)`` places state with ``NamedSharding(P('nodes', None))``
+and jits the full loop; GSPMD turns the cross-shard scatters/gathers into
+ICI collectives.  No data-dependent Python control flow: convergence is the
+``while_loop`` predicate, computed on-device.
+
+Fidelity contract with the reference simulator is enforced by
+tests/test_sim.py (exact round-count equality on all five BASELINE
+configs, small sizes).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .model import COMPLETE, ER, POWERLAW, SimParams
+from .rng import (
+    TAG_BCAST,
+    TAG_CHURN,
+    TAG_INJECT,
+    TAG_ORIGIN,
+    TAG_PART,
+    TAG_SYNC,
+    TAG_TOPO,
+    jx_below,
+)
+
+SimState = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # (have, budget, round)
+
+
+@dataclass
+class SimResult:
+    converged: bool
+    rounds: int
+    wall_s: float
+    compile_s: float = 0.0
+    coverage: List[float] = field(default_factory=list)
+
+
+def _consts(p: SimParams):
+    """Changeset origins / inject rounds and partition sides (eager)."""
+    karange = jnp.arange(p.n_changes, dtype=jnp.int32)
+    narange = jnp.arange(p.n_nodes, dtype=jnp.int32)
+    origin = jx_below(p.n_nodes, p.seed, TAG_ORIGIN, karange)
+    inject_round = jx_below(p.write_rounds, p.seed, TAG_INJECT, karange)
+    part = (
+        jx_below(1_000_000, p.seed, TAG_PART, narange) < p.partition_frac_ppm
+    ).astype(jnp.int8)
+    return origin, inject_round, part
+
+
+def init_state(p: SimParams) -> SimState:
+    have = jnp.zeros((p.n_nodes, p.n_changes), dtype=bool)
+    budget = jnp.zeros((p.n_nodes, p.n_changes), dtype=jnp.int8)
+    return have, budget, jnp.int32(0)
+
+
+def make_step(p: SimParams):
+    """Build the jittable one-round transition for params ``p``."""
+    N, K = p.n_nodes, p.n_changes
+    T8 = jnp.int8(p.max_transmissions)
+    origin, inject_round, part = _consts(p)
+    narange = jnp.arange(N, dtype=jnp.int32)
+    karange = jnp.arange(K, dtype=jnp.int32)
+
+    def bcast_target(r, j: int):
+        """Mirror of reference._bcast_target, vectorized over nodes."""
+        if p.topology == ER:
+            i = jx_below(p.er_degree, p.seed, TAG_BCAST, r, narange, j)
+            t = jx_below(N - 1, p.seed, TAG_TOPO, narange, i)
+        elif p.topology == POWERLAW:
+            draws = [
+                jx_below(
+                    N - 1, p.seed, TAG_BCAST, r, narange, j * p.powerlaw_gamma + g
+                )
+                for g in range(p.powerlaw_gamma)
+            ]
+            t = draws[0]
+            for d in draws[1:]:
+                t = jnp.minimum(t, d)
+        else:
+            assert p.topology == COMPLETE
+            t = jx_below(N - 1, p.seed, TAG_BCAST, r, narange, j)
+        return t + (t >= narange)  # skip self
+
+    def step(state: SimState) -> SimState:
+        have, budget, r = state
+        # 1. inject this round's writes at their origins
+        inj = inject_round == r
+        have = have.at[origin, karange].max(inj)
+        budget = budget.at[origin, karange].max(jnp.where(inj, T8, jnp.int8(0)))
+        # effective partition side (all-zero once healed)
+        pvec = jnp.where(r < p.partition_rounds, part, jnp.int8(0))
+        # 2. broadcast whole pending payloads to fanout targets
+        pend = budget > 0
+        delivered = jnp.zeros_like(have)
+        for j in range(p.fanout):
+            t = bcast_target(r, j)
+            ok = pvec == pvec[t]
+            delivered = delivered.at[t].max(pend & ok[:, None])
+        # 3. merge + budget bookkeeping (fresh budget ⇒ rebroadcast)
+        new = delivered & ~have
+        have = have | delivered
+        budget = jnp.where(
+            new, T8, jnp.where(pend, budget - jnp.int8(1), budget)
+        )
+        # 4. anti-entropy: simultaneous pull of one peer's full state
+        if p.sync_interval > 0:
+            q = jx_below(N - 1, p.seed, TAG_SYNC, r, narange)
+            q = q + (q >= narange)
+            okq = pvec == pvec[q]
+            pulled = have[q] & okq[:, None]
+            do = ((r + 1) % p.sync_interval) == 0
+            have = jnp.where(do, have | pulled, have)
+        # 5. churn: hash-selected restarts keep only their own writes
+        if p.churn_ppm > 0 and p.churn_rounds > 0:
+            draw = jx_below(1_000_000, p.seed, TAG_CHURN, r, narange)
+            restart = (draw < p.churn_ppm) & (r < p.churn_rounds)
+            own = (origin[None, :] == narange[:, None]) & (
+                inject_round[None, :] <= r
+            )
+            have = jnp.where(restart[:, None], own, have)
+            budget = jnp.where(
+                restart[:, None], jnp.where(own, T8, jnp.int8(0)), budget
+            )
+        return have, budget, r + 1
+
+    return step
+
+
+def _run_loop(p: SimParams, state: SimState) -> SimState:
+    step = make_step(p)
+
+    def cond(state):
+        have, _, r = state
+        return jnp.logical_and(~have.all(), r < p.max_rounds)
+
+    return lax.while_loop(cond, lambda s: step(s), state)
+
+
+def node_sharding(mesh: Mesh, axis: str = "nodes"):
+    return NamedSharding(mesh, P(axis, None))
+
+
+def run(
+    p: SimParams,
+    mesh: Optional[Mesh] = None,
+    mesh_axis: str = "nodes",
+) -> SimResult:
+    """Run to convergence (or max_rounds); returns timing split into
+    compile and execute so the <60 s north star is measured on execute+
+    compile both (BASELINE.md reports wall-clock)."""
+    state = init_state(p)
+    if mesh is not None:
+        sh = node_sharding(mesh, mesh_axis)
+        state = (
+            jax.device_put(state[0], sh),
+            jax.device_put(state[1], sh),
+            state[2],
+        )
+        fn = jax.jit(
+            partial(_run_loop, p),
+            in_shardings=((sh, sh, None),),
+            out_shardings=(sh, sh, None),
+        )
+    else:
+        fn = jax.jit(partial(_run_loop, p))
+    t0 = time.perf_counter()
+    compiled = fn.lower(state).compile()
+    t1 = time.perf_counter()
+    have, _, r = jax.block_until_ready(compiled(state))
+    t2 = time.perf_counter()
+    return SimResult(
+        converged=bool(have.all()),
+        rounds=int(r),
+        wall_s=t2 - t1,
+        compile_s=t1 - t0,
+    )
+
+
+def run_trace(p: SimParams, n_rounds: Optional[int] = None) -> SimResult:
+    """Fixed-round scan recording per-round coverage (analysis mode)."""
+    n_rounds = p.max_rounds if n_rounds is None else n_rounds
+    step = make_step(p)
+
+    def body(state, _):
+        state = step(state)
+        return state, state[0].sum()
+
+    t0 = time.perf_counter()
+    (have, _, r), counts = jax.block_until_ready(
+        jax.jit(lambda s: lax.scan(body, s, None, length=n_rounds))(init_state(p))
+    )
+    t1 = time.perf_counter()
+    total = p.n_nodes * p.n_changes
+    coverage = [int(c) / total for c in counts]
+    full = [i for i, c in enumerate(counts) if int(c) == total]
+    return SimResult(
+        converged=bool(have.all()),
+        rounds=(full[0] + 1) if full else n_rounds,
+        wall_s=t1 - t0,
+        coverage=coverage,
+    )

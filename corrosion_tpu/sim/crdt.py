@@ -1,0 +1,80 @@
+"""Vectorized CRDT merge over simulated cluster state.
+
+Models the cr-sqlite merge semantics the native engine implements
+(corrosion_tpu/crdt/src/crsqlite.cpp; reference doc/crdts.md:13-23) as
+max-reductions, so BASELINE config 4 ("multi-table w/ causal-length sets")
+exercises real merge algebra, not just set union:
+
+- each changeset k targets key ``key[k]`` with Lamport stamp
+  ``inject_round[k]``;
+- LWW register value = max over received changesets of
+  ``pack(col_version, value)`` — biggest col_version wins, ties broken by
+  biggest value (the reference's merge rule);
+- causal length = count of received toggle events per key (each change
+  toggles live/deleted; odd = live), converging with the have-set.
+
+``merge_registers`` is a per-node segment-max — on TPU a single fused
+gather/scatter-max, vmapped over the node axis.  Convergence of the
+have-matrix implies register equality across nodes; tests assert it
+directly and cross-check against a scalar Python fold.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .model import SimParams
+from .rng import TAG_INJECT, TAG_ORIGIN, jx_below, py_below
+
+TAG_KEY = 9
+
+
+def change_keys(p: SimParams, n_keys: int) -> jnp.ndarray:
+    k = jnp.arange(p.n_changes, dtype=jnp.int32)
+    return jx_below(n_keys, p.seed, TAG_KEY, k)
+
+
+def merge_registers(
+    have: jnp.ndarray, p: SimParams, n_keys: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(reg, cl): LWW register winners and causal lengths per (node, key).
+
+    reg[n, key] = max over {k : have[n, k], key[k]=key} of
+    lamport*K + k  (−1 when the node has no data for the key);
+    cl[n, key] = number of toggle events node n has received for key.
+    """
+    K = p.n_changes
+    keys = change_keys(p, n_keys)
+    lamport = jx_below(p.write_rounds, p.seed, TAG_INJECT, jnp.arange(K))
+    pack = lamport.astype(jnp.int32) * K + jnp.arange(K, dtype=jnp.int32)
+
+    def per_node(h):
+        vals = jnp.where(h, pack, jnp.int32(-1))
+        reg = jax.ops.segment_max(
+            vals, keys, num_segments=n_keys, indices_are_sorted=False
+        )
+        reg = jnp.maximum(reg, jnp.int32(-1))  # empty segment → "no data"
+        cl = jax.ops.segment_sum(h.astype(jnp.int32), keys, num_segments=n_keys)
+        return reg, cl
+
+    return jax.vmap(per_node)(have)
+
+
+def merge_registers_py(have_sets, p: SimParams, n_keys: int):
+    """Scalar reference of :func:`merge_registers` (for tests)."""
+    K = p.n_changes
+    keys = [py_below(n_keys, p.seed, TAG_KEY, k) for k in range(K)]
+    lamport = [py_below(p.write_rounds, p.seed, TAG_INJECT, k) for k in range(K)]
+    regs, cls_ = [], []
+    for h in have_sets:
+        reg = [-1] * n_keys
+        cl = [0] * n_keys
+        for k in h:
+            reg[keys[k]] = max(reg[keys[k]], lamport[k] * K + k)
+            cl[keys[k]] += 1
+        regs.append(reg)
+        cls_.append(cl)
+    return regs, cls_

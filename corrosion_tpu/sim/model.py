@@ -1,0 +1,137 @@
+"""Round-synchronous model of a Corrosion cluster, and the BASELINE configs.
+
+The reference system is continuous-time: per-node tokio timers drive SWIM
+probes (1 s period), broadcast re-sends (500 ms tick,
+crates/corro-agent/src/broadcast/mod.rs:583-595) and anti-entropy rounds
+(1-15 s backoff, crates/corro-agent/src/agent/util.rs:602-662).  The
+simulator abstracts this to a **round-synchronous** model — one round ≈ one
+broadcast re-send tick — which is the explicit abstraction SURVEY.md §7
+calls for.  Per round, in order:
+
+1. *Inject*: changesets scheduled for this round appear at their origin
+   node with a full retransmission budget (ref: local commit →
+   `make_broadcastable_changes`, api/public/mod.rs:39-242).
+2. *Broadcast*: every node with a non-empty pending set (budget > 0)
+   batches ALL pending changesets into one payload (ref: the broadcast
+   loop drains its queue into ≤64 KiB payloads, broadcast/mod.rs:377) and
+   sends it to `fanout` targets drawn from its topology neighbors
+   (ref: ring0 + random members, broadcast/mod.rs:488-547).  Deliveries
+   to dead nodes or across an active partition are lost.
+3. *Receive*: newly-seen changesets get a fresh budget of
+   `max_transmissions` (rebroadcast of unseen broadcast-sourced changes,
+   handlers.rs:530-538); senders decrement budgets by 1 (send_count,
+   broadcast/mod.rs:747-773).
+4. *Anti-entropy* (every `sync_interval` rounds): each node pulls the full
+   state of one random peer — the round-synchronous collapse of
+   generate_sync → compute_available_needs → chunked transfer
+   (api/peer.rs:921-1296).  Sync-sourced changes are NOT rebroadcast,
+   matching ChangeSource::Sync handling (handlers.rs:530).
+5. *Churn*: a hash-selected fraction of nodes restarts empty except for
+   its own already-written changesets (a replacement node re-registering
+   its local state — the Fly.io service-discovery pattern), recovering
+   the rest via anti-entropy.
+6. *Partition*: for the first `partition_rounds` rounds, nodes are split
+   into two sides (30%/70% in BASELINE config 5) and all traffic between
+   sides is dropped; afterwards the partition heals.
+
+Convergence (the metric in BENCH output) = first round at the end of which
+**every node holds every injected changeset** — the tensor form of the
+reference's convergence bar "all rows everywhere AND need_len()==0 on every
+node" (crates/corro-agent/src/agent/tests.rs:464-476).
+
+Topology: `complete` samples fanout targets uniformly from all-but-self;
+`er` precomputes a directed Erdős–Rényi out-neighbor table of degree
+`er_degree`; `powerlaw` biases target choice toward low-index hub nodes by
+taking the min of `powerlaw_gamma` independent uniform draws (integer-only
+Beta(1,γ) skew — no floats, see sim/rng.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+COMPLETE, ER, POWERLAW = "complete", "er", "powerlaw"
+
+
+@dataclass(frozen=True)
+class SimParams:
+    """Static (compile-time) parameters of one simulation."""
+
+    n_nodes: int
+    n_changes: int
+    fanout: int = 3
+    max_transmissions: int = 3  # ref default: broadcast max_transmissions
+    sync_interval: int = 5  # rounds between anti-entropy pulls; 0 = off
+    write_rounds: int = 1  # injections spread over rounds [0, write_rounds)
+    max_rounds: int = 256
+    topology: str = COMPLETE
+    er_degree: int = 10  # out-degree for topology == "er"
+    powerlaw_gamma: int = 3  # hub bias for topology == "powerlaw"
+    churn_ppm: int = 0  # per-round per-node restart prob, parts/million
+    churn_rounds: int = 0  # churn active during rounds [0, churn_rounds)
+    partition_frac_ppm: int = 0  # fraction of nodes on side B, ppm
+    partition_rounds: int = 0  # partition active during rounds [0, ..)
+    seed: int = 0
+
+    def with_(self, **kw) -> "SimParams":
+        return replace(self, **kw)
+
+
+# BASELINE.md benchmark configs 1-5 (BASELINE.json `configs`).
+def config1_ring3(seed: int = 0) -> SimParams:
+    """3-node ring, single-table LWW, fanout 2 — the CPU-reference anchor."""
+    return SimParams(
+        n_nodes=3, n_changes=8, fanout=2, max_transmissions=2,
+        sync_interval=3, write_rounds=2, max_rounds=64, seed=seed,
+    )
+
+
+def config2_er1k(seed: int = 0) -> SimParams:
+    """1k-node Erdős–Rényi, pure push gossip (no anti-entropy).
+
+    Push-only dissemination has no repair path, so the retransmission
+    budget is raised vs the anti-entropy configs: with out-degree 10,
+    fanout 3 and budget 6 a node's chance of being missed by all its
+    in-neighbors is (9/10)^18 per sender — vanishing at cluster scale.
+    """
+    return SimParams(
+        n_nodes=1000, n_changes=64, fanout=3, max_transmissions=6,
+        sync_interval=0, write_rounds=4, max_rounds=256,
+        topology=ER, er_degree=10, seed=seed,
+    )
+
+
+def config3_powerlaw10k(seed: int = 0) -> SimParams:
+    """10k-node power-law mesh, full gossip + anti-entropy."""
+    return SimParams(
+        n_nodes=10_000, n_changes=128, fanout=3, max_transmissions=3,
+        sync_interval=5, write_rounds=8, max_rounds=512,
+        topology=POWERLAW, powerlaw_gamma=3, seed=seed,
+    )
+
+
+def config4_churn100k(seed: int = 0) -> SimParams:
+    """100k-node multi-table with churn: 5%/round for 20 rounds."""
+    return SimParams(
+        n_nodes=100_000, n_changes=512, fanout=3, max_transmissions=3,
+        sync_interval=5, write_rounds=16, max_rounds=512,
+        churn_ppm=50_000, churn_rounds=20, seed=seed,
+    )
+
+
+def config5_partition100k(seed: int = 0) -> SimParams:
+    """100k nodes, 30% partitioned for 50 rounds, then heal."""
+    return SimParams(
+        n_nodes=100_000, n_changes=512, fanout=3, max_transmissions=3,
+        sync_interval=5, write_rounds=16, max_rounds=512,
+        partition_frac_ppm=300_000, partition_rounds=50, seed=seed,
+    )
+
+
+CONFIGS = {
+    1: config1_ring3,
+    2: config2_er1k,
+    3: config3_powerlaw10k,
+    4: config4_churn100k,
+    5: config5_partition100k,
+}

@@ -1,0 +1,136 @@
+"""Pure-Python per-node CPU reference simulator.
+
+This is the scalar, object-per-node implementation of the round model in
+:mod:`corrosion_tpu.sim.model` — the executable spec the vectorized TPU
+simulator (:mod:`corrosion_tpu.sim.cluster`) is validated against, playing
+the role BASELINE.md assigns to the `corro-devcluster`-equivalent CPU
+harness.  Because every random decision is the shared counter-based hash
+(sim/rng.py), round counts here and on TPU agree **bit-for-bit**; the
+`vs CPU reference ±2%` bar is met with 0% divergence by construction
+(asserted by tests/test_sim.py across all five BASELINE configs).
+
+State per node is a plain ``set`` of changeset ids plus a budget dict —
+deliberately naive so the semantics stay legible; use the JAX backend for
+anything beyond a few thousand nodes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .model import COMPLETE, ER, POWERLAW, SimParams
+from .rng import (
+    TAG_BCAST,
+    TAG_CHURN,
+    TAG_INJECT,
+    TAG_ORIGIN,
+    TAG_PART,
+    TAG_SYNC,
+    TAG_TOPO,
+    py_below,
+)
+
+
+@dataclass
+class RefResult:
+    converged: bool
+    rounds: int  # rounds executed until convergence (or max_rounds)
+    coverage: List[float] = field(default_factory=list)  # per-round fill
+    # final per-node have-sets, for exact state comparison with the JAX sim
+    have: List[Set[int]] = field(default_factory=list)
+
+
+def _bcast_target(p: SimParams, r: int, n: int, j: int) -> int:
+    """Fanout target for (round, node, slot) — must mirror sim.cluster."""
+    if p.topology == ER:
+        i = py_below(p.er_degree, p.seed, TAG_BCAST, r, n, j)
+        t = py_below(p.n_nodes - 1, p.seed, TAG_TOPO, n, i)
+    elif p.topology == POWERLAW:
+        t = min(
+            py_below(p.n_nodes - 1, p.seed, TAG_BCAST, r, n, j * p.powerlaw_gamma + g)
+            for g in range(p.powerlaw_gamma)
+        )
+    else:
+        assert p.topology == COMPLETE
+        t = py_below(p.n_nodes - 1, p.seed, TAG_BCAST, r, n, j)
+    return t + 1 if t >= n else t
+
+
+def _sync_peer(p: SimParams, r: int, n: int) -> int:
+    q = py_below(p.n_nodes - 1, p.seed, TAG_SYNC, r, n)
+    return q + 1 if q >= n else q
+
+
+def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
+    N, K, T = p.n_nodes, p.n_changes, p.max_transmissions
+    max_rounds = p.max_rounds if max_rounds is None else max_rounds
+
+    origin = [py_below(N, p.seed, TAG_ORIGIN, k) for k in range(K)]
+    inject_round = [py_below(p.write_rounds, p.seed, TAG_INJECT, k) for k in range(K)]
+    part = [
+        1 if py_below(1_000_000, p.seed, TAG_PART, n) < p.partition_frac_ppm else 0
+        for n in range(N)
+    ]
+
+    have: List[Set[int]] = [set() for _ in range(N)]
+    budget: List[Dict[int, int]] = [{} for _ in range(N)]
+    by_round: Dict[int, List[int]] = {}
+    for k in range(K):
+        by_round.setdefault(inject_round[k], []).append(k)
+
+    result = RefResult(converged=False, rounds=max_rounds)
+    for r in range(max_rounds):
+        part_on = r < p.partition_rounds
+        # 1. inject
+        for k in by_round.get(r, ()):  # noqa: B909 (read-only)
+            have[origin[k]].add(k)
+            budget[origin[k]][k] = T
+        # 2. broadcast: snapshot pending sets, deliver whole payloads
+        pend = [frozenset(k for k, b in budget[n].items() if b > 0) for n in range(N)]
+        delivered: List[Set[int]] = [set() for _ in range(N)]
+        for n in range(N):
+            if not pend[n]:
+                continue
+            for j in range(p.fanout):
+                t = _bcast_target(p, r, n, j)
+                if part_on and part[n] != part[t]:
+                    continue  # dropped at the partition boundary
+                delivered[t].update(pend[n])
+        # 3. receive: fresh budget for new changes, decrement for sent ones
+        for n in range(N):
+            new = delivered[n] - have[n]
+            have[n] |= delivered[n]
+            for k in pend[n]:
+                if k not in new:
+                    budget[n][k] -= 1
+            for k in new:
+                budget[n][k] = T
+        # 4. anti-entropy pull from one random peer (simultaneous snapshot)
+        if p.sync_interval > 0 and (r + 1) % p.sync_interval == 0:
+            snap = [frozenset(h) for h in have]
+            for n in range(N):
+                q = _sync_peer(p, r, n)
+                if part_on and part[n] != part[q]:
+                    continue
+                have[n] |= snap[q]
+        # 5. churn: restart keeps only the node's own persisted writes
+        if r < p.churn_rounds and p.churn_ppm > 0:
+            for n in range(N):
+                if py_below(1_000_000, p.seed, TAG_CHURN, r, n) < p.churn_ppm:
+                    own = {
+                        k
+                        for k in range(K)
+                        if origin[k] == n and inject_round[k] <= r
+                    }
+                    have[n] = set(own)
+                    budget[n] = {k: T for k in own}
+        # 6. convergence = every node holds every changeset
+        total = sum(len(h) for h in have)
+        result.coverage.append(total / float(N * K))
+        if total == N * K and all(len(h) == K for h in have):
+            result.converged = True
+            result.rounds = r + 1
+            break
+    result.have = have
+    return result

@@ -1,0 +1,100 @@
+"""Counter-based deterministic PRNG shared by the TPU sim and CPU reference.
+
+SURVEY.md §7 "hard parts" #3: matching the reference harness's discrete
+per-node randomness (probe targets, fanout choice, sync peer choice) with
+batched tensor sampling requires a careful RNG-stream design.  The design
+here is a *counter-based* 32-bit hash: every random decision is
+``hash(seed, tag, round, node, slot) mod n`` where the hash is an
+integer-only avalanche mix (Wellons' lowbias32).  Because the math is pure
+uint32 arithmetic, the JAX/TPU implementation (:func:`jx_hash`) and the
+pure-Python CPU reference implementation (:func:`py_hash`) are **bit
+identical**, so the simulated round counts agree exactly (0% divergence,
+inside BASELINE.md's ±2% bar by construction).
+
+No floats appear anywhere in the random path: cross-backend float
+differences (XLA fast-math vs libm) could otherwise flip a target choice
+and desynchronize the two simulators.
+
+Stream tags (domain separation):
+  TAG_ORIGIN  which node originates changeset k
+  TAG_INJECT  which round changeset k is written
+  TAG_BCAST   broadcast fanout target for (round, node, slot)
+  TAG_SYNC    anti-entropy peer for (round, node)
+  TAG_PROBE   SWIM probe target for (round, node)
+  TAG_CHURN   per-(round, node) restart draw
+  TAG_PART    partition-side assignment for node
+  TAG_TOPO    static topology neighbor table entry (node, slot)
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax.numpy as jnp
+
+_M = 0xFFFFFFFF
+_MIX1 = 0x7FEB352D
+_MIX2 = 0x846CA68B
+_GOLD = 0x9E3779B9
+
+TAG_ORIGIN = 1
+TAG_INJECT = 2
+TAG_BCAST = 3
+TAG_SYNC = 4
+TAG_PROBE = 5
+TAG_CHURN = 6
+TAG_PART = 7
+TAG_TOPO = 8
+
+
+def py_mix(x: int) -> int:
+    """lowbias32 avalanche (public-domain constants by C. Wellons)."""
+    x &= _M
+    x ^= x >> 16
+    x = (x * _MIX1) & _M
+    x ^= x >> 15
+    x = (x * _MIX2) & _M
+    x ^= x >> 16
+    return x
+
+
+def py_hash(seed: int, *fields: int) -> int:
+    """Chained mix over (seed, *fields); pure-Python reference side."""
+    h = py_mix((seed ^ 0x85EBCA6B) & _M)
+    for f in fields:
+        h = py_mix((h + (f & _M) * _GOLD) & _M)
+    return h
+
+
+def py_below(n: int, seed: int, *fields: int) -> int:
+    return py_hash(seed, *fields) % n
+
+
+def jx_mix(x):
+    """lowbias32 on uint32 arrays; bit-identical to :func:`py_mix`."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(_MIX1)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(_MIX2)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _u32(f):
+    if isinstance(f, int):
+        return jnp.uint32(f & _M)
+    return jnp.asarray(f).astype(jnp.uint32)
+
+
+def jx_hash(seed: int, *fields):
+    """Chained mix over (seed, *fields); fields may be scalars or arrays
+    (broadcast together).  Bit-identical to :func:`py_hash`."""
+    h = jx_mix(jnp.uint32(seed & _M) ^ jnp.uint32(0x85EBCA6B))
+    for f in fields:
+        h = jx_mix(h + _u32(f) * jnp.uint32(_GOLD))
+    return h
+
+
+def jx_below(n: Union[int, "jnp.ndarray"], seed: int, *fields):
+    return (jx_hash(seed, *fields) % jnp.uint32(n)).astype(jnp.int32)

@@ -272,8 +272,14 @@ class Swim:
             or (direct and actor.ts >= entry.actor.ts and entry.state != ALIVE)
         ):
             was_down_or_suspect = entry.state != ALIVE
+            if actor.ts > entry.actor.ts:
+                # renewed identity starts a fresh incarnation stream; keeping
+                # the old max would make us deaf to suspicion gossip about
+                # the rejoined node until our own probe times out
+                entry.incarnation = incarnation
+            else:
+                entry.incarnation = max(incarnation, entry.incarnation)
             entry.actor = actor
-            entry.incarnation = max(incarnation, entry.incarnation)
             entry.state = ALIVE
             entry.state_since = now
             self._queue_update(actor, ALIVE, entry.incarnation)

@@ -104,7 +104,37 @@ class SyncServer:
         """ref: process_sync → process_version → handle_known_version,
         peer.rs:350-827"""
         if isinstance(need, SyncNeedFull):
-            for version in range(need.versions[0], need.versions[1] + 1):
+            # Clamp the peer-supplied range to versions we actually have
+            # booked before iterating: the wire value is untrusted, and a
+            # (1, 10**15) range must not spin the event loop (the reference
+            # only walks its own bookkeeping, peer.rs:356-441).
+            booked = self.agent.bookie.get(actor_id)
+            if booked is None:
+                return
+            s, e = need.versions
+            async with booked.read(f"serve_sync:{actor_id.as_simple()}"):
+                last = booked.versions.last() or 0
+                e = min(e, last)
+                if e < s:
+                    return
+                known = sorted(
+                    [v for v in booked.versions.current if s <= v <= e]
+                    + [v for v in booked.versions.partials if s <= v <= e]
+                )
+                cleared = [
+                    (max(cs, s), min(ce, e))
+                    for cs, ce in booked.versions.cleared.overlapping(s, e)
+                ]
+            for crange in cleared:
+                await fs.send(
+                    wire.encode_sync_changeset(
+                        ChangeV1(
+                            actor_id=actor_id,
+                            changeset=ChangesetEmpty(versions=crange),
+                        )
+                    )
+                )
+            for version in known:
                 await self._serve_version(fs, actor_id, version, None)
         elif isinstance(need, SyncNeedPartial):
             await self._serve_version(fs, actor_id, need.version, list(need.seqs))

@@ -23,7 +23,7 @@ import contextlib
 import time
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
-from ..wire import deframe, frame
+from ..wire import WireError, deframe, frame
 
 Addr = Tuple[str, int]
 
@@ -46,20 +46,24 @@ class FramedStream:
         await self.writer.drain()
 
     async def recv(self, timeout: Optional[float] = None) -> Optional[bytes]:
-        """Next frame, or None on clean EOF."""
+        """Next frame, or None on clean EOF.  ``timeout`` bounds the wait
+        for the WHOLE frame, not each read: a peer dribbling one byte per
+        interval must not hold a sync permit forever."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             payload, consumed = deframe(memoryview(self._buf))
             if payload is not None:
                 del self._buf[:consumed]
                 return payload
-            try:
-                chunk = await (
-                    asyncio.wait_for(self.reader.read(65536), timeout)
-                    if timeout is not None
-                    else self.reader.read(65536)
+            if deadline is None:
+                chunk = await self.reader.read(65536)
+            else:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError("frame deadline exceeded")
+                chunk = await asyncio.wait_for(
+                    self.reader.read(65536), remaining
                 )
-            except asyncio.TimeoutError:
-                raise
             if not chunk:
                 if self._buf:
                     raise ConnectionError("stream ended mid-frame")
@@ -174,8 +178,8 @@ class Transport:
             elif magic == BI_MAGIC:
                 if self.on_bi_stream is not None:
                     await self.on_bi_stream(addr, fs)
-        except (ConnectionError, asyncio.IncompleteReadError):
-            pass
+        except (ConnectionError, asyncio.IncompleteReadError, WireError):
+            pass  # malformed/truncated peer data must not escape the task
         finally:
             self._inbound.discard(fs)
             fs.close()

@@ -1,0 +1,100 @@
+"""Headline benchmark: 100k-node cluster simulated to CRDT convergence.
+
+North star (BASELINE.md): simulate a 100k-node Corrosion cluster to full
+CRDT convergence in < 60 s wall-clock, with gossip-round counts matching
+the CPU reference within ±2% (matched exactly by the shared RNG design —
+asserted here at reduced scale, and by tests/test_sim.py on all configs).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
+value = total wall-clock (compile + execute) of the 100k-node churn config
+(BASELINE config 4) run to convergence on the attached accelerator.
+vs_baseline = 60 / value (>1 ⇒ beats the north-star bound).
+
+Extra diagnostics go to stderr; `--config N` selects another BASELINE
+config, `--scale F` scales node count (dev/debug).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=4)
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    t_all = time.perf_counter()
+    import jax
+
+    from corrosion_tpu.sim import cluster, crdt, model, reference
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    p = model.CONFIGS[args.config](seed=args.seed)
+    if args.scale != 1.0:
+        p = p.with_(n_nodes=max(8, int(p.n_nodes * args.scale)))
+    log(f"config {args.config}: {p}")
+
+    # fidelity spot-check vs the CPU reference at reduced scale (the full
+    # fidelity matrix runs in tests/test_sim.py)
+    small = p.with_(
+        n_nodes=min(p.n_nodes, 128),
+        n_changes=min(p.n_changes, 16),
+        churn_rounds=min(p.churn_rounds, 6),
+        partition_rounds=min(p.partition_rounds, 8),
+    )
+    ref = reference.run_reference(small)
+    got = cluster.run(small)
+    assert got.rounds == ref.rounds and got.converged == ref.converged, (
+        f"fidelity check failed: jax={got.rounds} ref={ref.rounds}"
+    )
+    log(
+        f"fidelity @n={small.n_nodes}: rounds jax={got.rounds} "
+        f"ref={ref.rounds} (exact match)"
+    )
+
+    res = cluster.run(p, return_state=True)
+    log(
+        f"run: converged={res.converged} rounds={res.rounds} "
+        f"compile={res.compile_s:.2f}s execute={res.wall_s:.2f}s"
+    )
+
+    # CRDT merge on the final state: every node must agree on every LWW
+    # register and causal length (one vmapped segment-max on device)
+    t0 = time.perf_counter()
+    reg, cl = crdt.merge_registers(res.state[0], p, n_keys=64)
+    reg_ok = bool((reg == reg[0]).all()) and bool((cl == cl[0]).all())
+    crdt_s = time.perf_counter() - t0
+    log(f"crdt merge agreement across nodes: {reg_ok} ({crdt_s:.2f}s)")
+    assert reg_ok or not res.converged, "converged but CRDT states disagree"
+
+    total = res.compile_s + res.wall_s
+    out = {
+        "metric": f"sim_{p.n_nodes}n_config{args.config}_convergence_wall",
+        "value": round(total, 3),
+        "unit": "s",
+        "vs_baseline": round(60.0 / total, 2) if total > 0 else 0.0,
+        "converged": res.converged,
+        "rounds": res.rounds,
+        "execute_s": round(res.wall_s, 3),
+        "compile_s": round(res.compile_s, 3),
+        "device": dev.platform,
+    }
+    log(f"total harness wall (incl. imports): {time.perf_counter()-t_all:.2f}s")
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

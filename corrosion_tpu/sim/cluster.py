@@ -59,6 +59,7 @@ class SimResult:
     wall_s: float
     compile_s: float = 0.0
     coverage: List[float] = field(default_factory=list)
+    state: Optional[SimState] = None  # final (have, budget, r) if requested
 
 
 def _consts(p: SimParams):
@@ -170,6 +171,7 @@ def run(
     p: SimParams,
     mesh: Optional[Mesh] = None,
     mesh_axis: str = "nodes",
+    return_state: bool = False,
 ) -> SimResult:
     """Run to convergence (or max_rounds); returns timing split into
     compile and execute so the <60 s north star is measured on execute+
@@ -192,13 +194,14 @@ def run(
     t0 = time.perf_counter()
     compiled = fn.lower(state).compile()
     t1 = time.perf_counter()
-    have, _, r = jax.block_until_ready(compiled(state))
+    have, budget, r = jax.block_until_ready(compiled(state))
     t2 = time.perf_counter()
     return SimResult(
         converged=bool(have.all()),
         rounds=int(r),
         wall_s=t2 - t1,
         compile_s=t1 - t0,
+        state=(have, budget, r) if return_state else None,
     )
 
 

@@ -19,6 +19,7 @@ from typing import List, Optional, Tuple
 
 from ..api.http import Api
 from ..broadcast.runtime import BroadcastRuntime
+from ..pubsub import SubsManager
 from ..swim.core import Swim, SwimConfig
 from ..sync.session import SyncServer, parallel_sync
 from ..transport.net import Transport
@@ -57,7 +58,9 @@ class Node:
         self.ingest: Optional[ChangeIngest] = None
         self.sync_server: Optional[SyncServer] = None
         self.api: Optional[Api] = None
+        self.subs: Optional[SubsManager] = None
         self._tasks: List[asyncio.Task] = []
+        self._subs_tmpdir = None  # TemporaryDirectory for :memory: nodes
         self._started = False
 
     # -- lifecycle --------------------------------------------------------
@@ -72,6 +75,16 @@ class Node:
             with open(path) as f:
                 sql = f.read()
             await self.agent.pool.write_call(lambda c, s=sql: apply_schema(c, s))
+
+        subs_path = self.config.db.resolved_subscriptions_path()
+        if subs_path is None:
+            import tempfile
+
+            self._subs_tmpdir = tempfile.TemporaryDirectory(prefix="corro-subs-")
+            subs_path = self._subs_tmpdir.name
+        self.subs = SubsManager(subs_path, self.agent.pool)
+        await self.subs.restore()  # ref: run_root.rs:229-282
+        self.subs.start()
 
         self.members = Members(self.agent.actor_id)
         self.sync_server = SyncServer(self.agent, cluster_id)
@@ -111,6 +124,7 @@ class Node:
             rebroadcast=lambda changes: self.broadcast.enqueue(
                 changes, rebroadcast=True
             ),
+            notify=self._notify_subs,
             apply_queue_len=self.config.perf.apply_queue_len,
             flush_interval=self.config.perf.flush_interval,
         )
@@ -118,6 +132,7 @@ class Node:
             self.agent,
             broadcast_hook=lambda changes: self.broadcast.enqueue(changes),
             authz_token=self.config.api.authz_bearer,
+            subs=self.subs,
         )
         await self.api.start(api_host, api_port)
 
@@ -146,11 +161,16 @@ class Node:
             await self.ingest.stop()
         if self.broadcast is not None:
             await self.broadcast.stop()
+        if self.subs is not None:
+            await self.subs.stop()
         if self.api is not None:
             await self.api.stop()
         if self.transport is not None:
             await self.transport.stop()
         self.agent.close()
+        if self._subs_tmpdir is not None:
+            self._subs_tmpdir.cleanup()
+            self._subs_tmpdir = None
         self._started = False
 
     # -- addresses --------------------------------------------------------
@@ -253,6 +273,11 @@ class Node:
                 raise
 
         await self.agent.pool.write_call(_write)
+
+    async def _notify_subs(self, applied) -> None:
+        """Remote-apply subscription notify (ref: util.rs:1380-1384)."""
+        if self.subs is not None:
+            self.subs.match_changes(applied)
 
     # -- stream plumbing --------------------------------------------------
 

@@ -30,6 +30,7 @@ from typing import Any, Callable, List, Optional, Tuple
 from aiohttp import web
 
 from ..agent import Agent, make_broadcastable_changes
+from ..types.change import jsonify_cell as _encode_cell
 from ..types.schema import SchemaError, apply_schema
 
 
@@ -66,12 +67,6 @@ def _decode_value(v: Any) -> Any:
     return v
 
 
-def _encode_cell(v: Any) -> Any:
-    if isinstance(v, bytes):
-        return {"blob": v.hex()}
-    return v
-
-
 class Api:
     """HTTP API server bound to one agent."""
 
@@ -80,12 +75,14 @@ class Api:
         agent: Agent,
         broadcast_hook: Optional[Callable] = None,
         authz_token: Optional[str] = None,
+        subs=None,
     ) -> None:
         self.agent = agent
         # called with the list of ChangeV1 produced by a local commit, so the
         # broadcast layer can fan them out (ref: tx_bcast in mod.rs:207-226)
         self.broadcast_hook = broadcast_hook
         self.authz_token = authz_token
+        self.subs = subs  # SubsManager; local commits notify it directly
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
 
@@ -97,6 +94,10 @@ class Api:
         app.router.add_post("/v1/queries", self.query_handler)
         app.router.add_post("/v1/migrations", self.migrations_handler)
         app.router.add_post("/v1/table_stats", self.table_stats_handler)
+        if self.subs is not None:
+            from .subs import SubsApi
+
+            SubsApi(self.subs).register(app)
         return app
 
     @web.middleware
@@ -140,6 +141,11 @@ class Api:
             return web.json_response({"error": str(e)}, status=400)
         if self.broadcast_hook is not None and outcome.changesets:
             await self.broadcast_hook(outcome.changesets)
+        if self.subs is not None and outcome.changesets:
+            # local-commit subscription notify (ref: mod.rs:205 match_changes)
+            self.subs.match_changes(
+                [(c.actor_id, c.changeset) for c in outcome.changesets]
+            )
         return web.json_response(
             {
                 "results": [

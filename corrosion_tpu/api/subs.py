@@ -1,0 +1,147 @@
+"""HTTP subscription endpoints.
+
+Equivalent of crates/corro-agent/src/api/public/pubsub.rs:
+
+- ``POST /v1/subscriptions`` — upsert a subscription by normalized SQL and
+  stream NDJSON query events (api_v1_subs);
+- ``GET /v1/subscriptions/:id`` — re-attach to a live subscription
+  (api_v1_sub_by_id, pubsub.rs:36-107), with ``?from=<change_id>``
+  catch-up served from the sub DB's ``changes`` table and ``?skip_rows``;
+- the subscription id is returned in the ``corro-query-id`` header
+  (pubsub.rs:102-107).
+
+Event lines (corro-api-types QueryEvent): ``{"columns": [...]}``,
+``{"row": [rowid, cells]}``, ``{"eoq": {"time": t, "change_id": n}}``,
+``{"change": [type, rowid, cells, change_id]}``, ``{"error": msg}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from ..pubsub import Matcher, MatcherError, SubsManager
+from .http import parse_statement
+
+QUERY_ID_HEADER = "corro-query-id"
+
+
+class SubsApi:
+    """Subscription route handlers bound to one SubsManager."""
+
+    def __init__(self, subs: SubsManager) -> None:
+        self.subs = subs
+
+    def register(self, app: web.Application) -> None:
+        app.router.add_post("/v1/subscriptions", self.create_handler)
+        app.router.add_get("/v1/subscriptions/{id}", self.attach_handler)
+
+    # -- handlers ----------------------------------------------------------
+
+    async def create_handler(self, request: web.Request) -> web.StreamResponse:
+        try:
+            raw = await request.json()
+            sql, params = parse_statement(raw)
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if params:
+            return web.json_response(
+                {"error": "subscription statements cannot take parameters"},
+                status=400,
+            )
+        try:
+            matcher, _created = await self.subs.get_or_insert(sql)
+        except MatcherError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return await self._serve(request, matcher)
+
+    async def attach_handler(self, request: web.Request) -> web.StreamResponse:
+        matcher = self.subs.get(request.match_info["id"])
+        if matcher is None:
+            return web.json_response({"error": "unknown subscription"}, status=404)
+        return await self._serve(request, matcher)
+
+    # -- streaming ---------------------------------------------------------
+
+    async def _serve(
+        self, request: web.Request, matcher: Matcher
+    ) -> web.StreamResponse:
+        from_id: Optional[int] = None
+        if "from" in request.query:
+            try:
+                from_id = int(request.query["from"])
+            except ValueError:
+                return web.json_response({"error": "bad from id"}, status=400)
+        skip_rows = request.query.get("skip_rows", "") in ("true", "1")
+
+        matcher.pin()  # fence against the zero-listener GC while serving
+        try:
+            await matcher.ready.wait()
+            if matcher.failed is not None:
+                return web.json_response({"error": matcher.failed}, status=500)
+            return await self._stream(request, matcher, from_id, skip_rows)
+        finally:
+            matcher.unpin()
+
+    async def _stream(
+        self,
+        request: web.Request,
+        matcher: Matcher,
+        from_id: Optional[int],
+        skip_rows: bool,
+    ) -> web.StreamResponse:
+        sub = matcher.attach()
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "application/x-ndjson",
+                QUERY_ID_HEADER: matcher.id,
+            }
+        )
+        await resp.prepare(request)
+
+        async def write(obj: dict) -> None:
+            await resp.write(json.dumps(obj).encode() + b"\n")
+
+        try:
+            if from_id is not None:
+                # catch-up from the persisted changes log, then go live;
+                # purged history shows up as a change-id gap the client's
+                # MissedChange detection handles (corro-client sub.rs:139-150)
+                _cols, rows, cutoff = await asyncio.to_thread(
+                    matcher.read_catch_up, from_id
+                )
+                for change_id, typ, rowid, cells in rows:
+                    await write(
+                        {"change": [typ, rowid, json.loads(cells), change_id]}
+                    )
+            else:
+                cols, rows, cutoff = await asyncio.to_thread(
+                    matcher.read_snapshot
+                )
+                await write({"columns": cols})
+                if not skip_rows:
+                    for rowid, cells in rows:
+                        await write({"row": [rowid, json.loads(cells)]})
+                await write({"eoq": {"time": 0.0, "change_id": cutoff}})
+
+            while True:
+                event = await sub.queue.get()
+                if event.get("__closed"):
+                    break
+                # events the snapshot/catch-up already covered
+                if "change" in event and event["change"][3] <= cutoff:
+                    continue
+                await write(event)
+                if "error" in event:
+                    break
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            matcher.detach(sub)
+        with contextlib.suppress(ConnectionResetError):
+            await resp.write_eof()
+        return resp

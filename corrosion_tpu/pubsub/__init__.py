@@ -1,0 +1,179 @@
+"""Subscription engine: SubsManager registry + Matcher materializers.
+
+Equivalent of crates/corro-types/src/pubsub.rs ``SubsManager``
+(pubsub.rs:53-249): matchers are keyed both by id and by normalized SQL so
+identical subscriptions share one materializer; subscriptions persist in
+per-sub directories and are restored on boot (pubsub.rs:773-809 +
+run_root.rs:229-282); matchers with no listeners are garbage-collected
+after a grace period (api/public/pubsub.rs:126-222: 120 s zero-listener GC).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import shutil
+import time
+import uuid
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from .matcher import Matcher, Subscriber, SubscriberLagged
+from .sql import MatcherError, normalize_sql
+
+__all__ = [
+    "SubsManager",
+    "Matcher",
+    "MatcherError",
+    "Subscriber",
+    "SubscriberLagged",
+    "normalize_sql",
+]
+
+logger = logging.getLogger(__name__)
+
+GC_TIMEOUT = 120.0  # ref: api/public/pubsub.rs zero-listener GC
+GC_TICK = 30.0
+
+
+class SubsManager:
+    """Registry of live subscription matchers (ref: SubsManager)."""
+
+    def __init__(self, subs_path: str, pool) -> None:
+        self.subs_path = Path(subs_path)
+        self.pool = pool
+        self.by_id: Dict[str, Matcher] = {}
+        self.by_sql: Dict[str, Matcher] = {}
+        self._lock = asyncio.Lock()
+        self._gc_task: Optional[asyncio.Task] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._gc_task = asyncio.create_task(self._gc_loop(), name="subs-gc")
+
+    async def stop(self) -> None:
+        if self._gc_task is not None:
+            self._gc_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._gc_task
+            self._gc_task = None
+        for matcher in list(self.by_id.values()):
+            await matcher.stop()
+        self.by_id.clear()
+        self.by_sql.clear()
+
+    async def restore(self) -> int:
+        """Recreate matchers persisted under ``subs_path`` (ref: restore
+        logic, run_root.rs:229-282)."""
+        import sqlite3
+
+        restored = 0
+        if not self.subs_path.is_dir():
+            return 0
+        for sub_dir in sorted(self.subs_path.iterdir()):
+            db = sub_dir / "sub.sqlite"
+            if not db.is_file():
+                continue
+            try:
+                conn = sqlite3.connect(db)
+                rows = dict(
+                    conn.execute(
+                        "SELECT key, value FROM meta WHERE key IN ('id','sql')"
+                    ).fetchall()
+                )
+                conn.close()
+                sub_id, sql_text = rows.get("id"), rows.get("sql")
+                if not sub_id or not sql_text:
+                    continue
+                matcher = await Matcher.create(
+                    sub_id, sql_text, sub_dir, self.pool, restore=True
+                )
+                matcher.start()
+                self.by_id[sub_id] = matcher
+                self.by_sql[matcher.normalized] = matcher
+                restored += 1
+            except Exception:
+                logger.exception("failed to restore subscription from %s", sub_dir)
+        return restored
+
+    # -- registry ----------------------------------------------------------
+
+    async def get_or_insert(self, sql_text: str) -> Tuple[Matcher, bool]:
+        """Find an equivalent live subscription or create one
+        (ref: SubsManager::get_or_insert, pubsub.rs:77-125)."""
+        normalized = normalize_sql(sql_text)
+        async with self._lock:
+            existing = self.by_sql.get(normalized)
+            if existing is not None and existing.failed is None:
+                existing.last_seen = time.monotonic()
+                return existing, False
+            if existing is not None:  # replace a dead matcher
+                self.by_id.pop(existing.id, None)
+                self.by_sql.pop(normalized, None)
+                asyncio.ensure_future(existing.stop())
+            sub_id = str(uuid.uuid4())
+            matcher = await Matcher.create(
+                sub_id, sql_text, self.subs_path / sub_id, self.pool
+            )
+            matcher.start()
+            self.by_id[sub_id] = matcher
+            self.by_sql[normalized] = matcher
+            return matcher, True
+
+    def get(self, sub_id: str) -> Optional[Matcher]:
+        matcher = self.by_id.get(sub_id)
+        if matcher is not None:
+            # a lookup counts as liveness — without this the GC could reap
+            # the matcher between get() and the caller's pin()/attach()
+            matcher.last_seen = time.monotonic()
+        return matcher
+
+    async def remove(self, sub_id: str) -> bool:
+        async with self._lock:
+            matcher = self.by_id.pop(sub_id, None)
+            if matcher is None:
+                return False
+            self.by_sql.pop(matcher.normalized, None)
+        await matcher.stop()
+        with contextlib.suppress(OSError):
+            shutil.rmtree(matcher.sub_dir)
+        return True
+
+    # -- change routing ----------------------------------------------------
+
+    def match_changes(self, applied: List[Tuple]) -> None:
+        """Route applied changesets to interested matchers (ref:
+        match_changes, pubsub.rs:162-214).  ``applied`` is the ingest
+        pipeline's ``(actor_id, Changeset)`` list."""
+        if not self.by_id:
+            return
+        changes = []
+        for _actor, changeset in applied:
+            changes.extend(getattr(changeset, "changes", ()))
+        if not changes:
+            return
+        for matcher in self.by_id.values():
+            matcher.filter_changes(changes)
+
+    # -- GC ----------------------------------------------------------------
+
+    async def _gc_loop(self) -> None:
+        while True:
+            await asyncio.sleep(GC_TICK)
+            now = time.monotonic()
+            doomed = [
+                m.id
+                for m in self.by_id.values()
+                if m.failed is not None
+                or (
+                    not m.has_subscribers
+                    and m.pins == 0
+                    and m.ready.is_set()
+                    and now - m.last_seen > GC_TIMEOUT
+                )
+            ]
+            for sub_id in doomed:
+                logger.info("GC: removing idle subscription %s", sub_id)
+                await self.remove(sub_id)

@@ -1,0 +1,639 @@
+"""Subscription matcher: incremental materialized query + change stream.
+
+Equivalent of the runtime half of crates/corro-types/src/pubsub.rs:
+
+- per-subscription **own SQLite DB** (``sub.sqlite`` with ``query``,
+  ``changes``, ``meta``, ``columns`` tables — pubsub.rs:844-877);
+- initial query streamed as Row events (pubsub.rs:1139-1250);
+- candidate aggregation ≤500 or 600 ms then a diff pass producing
+  insert/update/delete change rows with a monotonic ChangeId
+  (pubsub.rs:1022-1137);
+- old change rows purged periodically (pubsub.rs:1129: 5 min cadence).
+
+The diff strategy differs from the reference's temp-table EXCEPT joins (we
+have no server-side temp-table plumbing shared across DBs): each batch
+re-runs the subscription query *restricted to the candidate PKs* per
+FROM-table (sql.py's rewriting) against the main store, then diffs the
+returned rows against the persisted ``query`` table by identity — identity
+being the packed PK tuple of every FROM-table, exactly the reference's
+``__corro_pk``-alias scheme.  Tables referenced outside the FROM clause
+(IN-subqueries etc.) fall back to a full re-run diff — slower, always
+correct.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import sqlite3
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..types.change import SqliteValue, jsonify_cell as _encode_cell
+from ..types.columns import pack_columns
+from . import sql as sqlmod
+from .sql import MatcherError, ParsedSelect, pk_alias
+
+logger = logging.getLogger(__name__)
+
+CANDIDATE_BATCH_MAX = 500  # ref: pubsub.rs candidate cap
+CANDIDATE_BATCH_WINDOW = 0.6  # ref: 600 ms aggregation window
+PURGE_INTERVAL = 300.0  # ref: 5 min purge cadence
+CHANGES_RETENTION = 10_000  # newest change rows kept for catch-up
+SUBSCRIBER_QUEUE_SIZE = 1024
+
+
+def _cells_json(cells: Sequence[SqliteValue]) -> str:
+    return json.dumps([_encode_cell(c) for c in cells])
+
+
+class SubscriberLagged(Exception):
+    """A subscriber queue overflowed; the stream must be dropped."""
+
+
+@dataclass
+class Subscriber:
+    queue: asyncio.Queue
+
+    def push(self, event: dict) -> None:
+        try:
+            self.queue.put_nowait(event)
+        except asyncio.QueueFull:
+            raise SubscriberLagged()
+
+
+class Matcher:
+    """One subscription's materializer (ref: Matcher, pubsub.rs:509+)."""
+
+    def __init__(
+        self,
+        id: str,
+        sql_text: str,
+        normalized: str,
+        parsed: ParsedSelect,
+        pks: List[List[str]],
+        trigger_tables: Set[str],
+        sub_dir: Path,
+        pool,
+    ) -> None:
+        self.id = id
+        self.sql = sql_text
+        self.normalized = normalized
+        self.parsed = parsed
+        self.pks = pks  # pk column names per FROM-table
+        self.trigger_tables = trigger_tables
+        self.from_tables = [t.name for t in parsed.tables]
+        # tables that force a full re-run (read outside the FROM clause);
+        # OUTER joins NULL-extend rows a per-table PK restriction can't
+        # retract/resurrect, so they full-re-run on every candidate
+        if parsed.has_outer_join:
+            self.full_rerun_tables = set(trigger_tables)
+        else:
+            self.full_rerun_tables = trigger_tables - set(self.from_tables)
+        self.sub_dir = sub_dir
+        self.pool = pool
+        self.rewritten = sqlmod.rewrite_with_pks(parsed, pks)
+        self.n_pk_cols = sum(len(p) for p in pks)
+        self.columns: List[str] = []
+        self.state = "created"  # created → filling → running
+        self.ready = asyncio.Event()  # set once a snapshot is servable
+        self.failed: Optional[str] = None  # terminal error, set with ready
+        self.last_change_id = 0
+        self.last_seen: float = time.monotonic()
+        self.pins = 0  # in-flight HTTP serves; fences the manager's GC
+        self._subs: List[Subscriber] = []
+        self._cands: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self._conn: Optional[sqlite3.Connection] = None
+        self._last_purge = time.monotonic()
+
+    # -- setup -------------------------------------------------------------
+
+    @classmethod
+    async def create(
+        cls, id: str, sql_text: str, sub_dir: Path, pool, restore: bool = False
+    ) -> "Matcher":
+        """Parse + validate the query against the live schema and build the
+        matcher (ref: Matcher::create / restore, pubsub.rs:509-925,773-809)."""
+        normalized = sqlmod.normalize_sql(sql_text)
+        parsed = sqlmod.parse_select(sql_text)
+
+        def _introspect(conn: sqlite3.Connection):
+            refs = sqlmod.referenced_tables(conn, parsed.sql)
+            crr: Set[str] = {
+                r[0]
+                for r in conn.execute(
+                    "SELECT name FROM sqlite_master WHERE type='table' AND "
+                    "name LIKE '%__crsql_clock'"
+                ).fetchall()
+            }
+            crr = {name[: -len("__crsql_clock")] for name in crr}
+            pks: List[List[str]] = []
+            for t in parsed.tables:
+                if t.name not in crr:
+                    raise MatcherError(
+                        f"table {t.name!r} is not a CRR (not in the schema)"
+                    )
+                info = conn.execute(
+                    f"PRAGMA table_info({sqlmod.quote_ident(t.name)})"
+                ).fetchall()
+                pk_cols = [
+                    r[1] for r in sorted(
+                        (r for r in info if r[5] > 0), key=lambda r: r[5]
+                    )
+                ]
+                if not pk_cols:
+                    raise MatcherError(f"table {t.name!r} has no primary key")
+                pks.append(pk_cols)
+            triggers = {t for t in refs if t in crr}
+            return pks, triggers
+
+        pks, triggers = await pool.read_call(_introspect)
+        m = cls(
+            id=id,
+            sql_text=sql_text,
+            normalized=normalized,
+            parsed=parsed,
+            pks=pks,
+            trigger_tables=triggers,
+            sub_dir=Path(sub_dir),
+            pool=pool,
+        )
+
+        # the PK-injected rewrite must itself compile — catching rewrite
+        # bugs here turns them into a 400 instead of a dead matcher
+        def _validate(conn: sqlite3.Connection):
+            try:
+                conn.execute(f"SELECT * FROM ({m.rewritten}) LIMIT 0")
+            except sqlite3.Error as e:
+                raise MatcherError(
+                    f"query cannot be used for subscriptions: {e}"
+                ) from e
+
+        await pool.read_call(_validate)
+        m._open_sub_db(restore=restore)
+        return m
+
+    def _open_sub_db(self, restore: bool) -> None:
+        self.sub_dir.mkdir(parents=True, exist_ok=True)
+        path = self.sub_dir / "sub.sqlite"
+        conn = sqlite3.connect(path, check_same_thread=False)
+        conn.execute("PRAGMA journal_mode = WAL")
+        conn.execute("PRAGMA synchronous = NORMAL")
+        pk_cols_ddl = "".join(
+            f", pk_{i} BLOB" for i in range(len(self.parsed.tables))
+        )
+        conn.executescript(
+            f"""
+            CREATE TABLE IF NOT EXISTS meta (key TEXT PRIMARY KEY, value);
+            CREATE TABLE IF NOT EXISTS columns (
+              idx INTEGER PRIMARY KEY, name TEXT NOT NULL);
+            CREATE TABLE IF NOT EXISTS query (
+              ident BLOB PRIMARY KEY, rowid_out INTEGER NOT NULL,
+              cells TEXT NOT NULL{pk_cols_ddl});
+            CREATE TABLE IF NOT EXISTS changes (
+              id INTEGER PRIMARY KEY AUTOINCREMENT, type TEXT NOT NULL,
+              rowid INTEGER NOT NULL, cells TEXT NOT NULL, ts REAL);
+            """
+        )
+        for i in range(len(self.parsed.tables)):
+            conn.execute(
+                f"CREATE INDEX IF NOT EXISTS query_pk_{i} ON query (pk_{i})"
+            )
+        self._conn = conn
+        if restore:
+            row = conn.execute(
+                "SELECT value FROM meta WHERE key = 'last_change_id'"
+            ).fetchone()
+            self.last_change_id = int(row[0]) if row else 0
+            self.columns = [
+                r[0]
+                for r in conn.execute(
+                    "SELECT name FROM columns ORDER BY idx"
+                ).fetchall()
+            ]
+            self.state = "restoring"
+        else:
+            conn.execute(
+                "INSERT OR REPLACE INTO meta (key, value) VALUES ('sql', ?)",
+                (self.sql,),
+            )
+            conn.execute(
+                "INSERT OR REPLACE INTO meta (key, value) VALUES ('id', ?)",
+                (self.id,),
+            )
+            conn.commit()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name=f"matcher-{self.id}")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+        for sub in self._subs:
+            with contextlib.suppress(asyncio.QueueFull):
+                sub.queue.put_nowait({"eoq": None, "__closed": True})
+        self._subs.clear()
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    @property
+    def has_subscribers(self) -> bool:
+        return bool(self._subs)
+
+    # -- candidates in -----------------------------------------------------
+
+    def submit_candidates(
+        self, cands: Dict[str, Set[bytes]], full_rerun: bool
+    ) -> None:
+        self._cands.put_nowait((cands, full_rerun))
+
+    def filter_changes(self, changes) -> None:
+        """Feed applied Change rows into this matcher (ref: match_changes,
+        pubsub.rs:162-214)."""
+        cands: Dict[str, Set[bytes]] = {}
+        full = False
+        for ch in changes:
+            if ch.table not in self.trigger_tables:
+                continue
+            if ch.table in self.full_rerun_tables:
+                full = True
+            else:
+                cands.setdefault(ch.table, set()).add(bytes(ch.pk))
+        if cands or full:
+            self.submit_candidates(cands, full)
+
+    # -- event fan-out -----------------------------------------------------
+
+    def attach(self) -> Subscriber:
+        """Register a live-event subscriber.  The HTTP layer deduplicates
+        the queue against the change-id cutoff of its snapshot/catch-up
+        read, so attach-before-read never loses or duplicates events."""
+        sub = Subscriber(queue=asyncio.Queue(maxsize=SUBSCRIBER_QUEUE_SIZE))
+        self._subs.append(sub)
+        self.last_seen = time.monotonic()
+        return sub
+
+    def detach(self, sub: Subscriber) -> None:
+        with contextlib.suppress(ValueError):
+            self._subs.remove(sub)
+        self.last_seen = time.monotonic()
+
+    def pin(self) -> None:
+        """Fence this matcher against GC while an HTTP serve is in flight
+        (covers the window before attach, incl. waiting on ``ready``)."""
+        self.pins += 1
+        self.last_seen = time.monotonic()
+
+    def unpin(self) -> None:
+        self.pins -= 1
+        self.last_seen = time.monotonic()
+
+    def _publish(self, event: dict) -> None:
+        dead: List[Subscriber] = []
+        for sub in self._subs:
+            try:
+                sub.push(event)
+            except SubscriberLagged:
+                dead.append(sub)
+        for sub in dead:
+            logger.warning("subscription %s: dropping lagged subscriber", self.id)
+            self._subs.remove(sub)
+
+    # -- snapshot reads (used by the HTTP layer for catch-up) --------------
+    #
+    # These open their own connection to sub.sqlite (WAL → concurrent
+    # readers) so one BEGIN gives an atomic (rows, last_change_id) view the
+    # live queue can be deduplicated against.
+
+    def _reader(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.sub_dir / "sub.sqlite")
+        conn.execute("PRAGMA query_only = 1")
+        return conn
+
+    def read_snapshot(self) -> Tuple[List[str], List[Tuple[int, str]], int]:
+        """(columns, [(rowid, cells_json)], cutoff_change_id), atomically."""
+        conn = self._reader()
+        try:
+            conn.execute("BEGIN")
+            cols = [
+                r[0]
+                for r in conn.execute(
+                    "SELECT name FROM columns ORDER BY idx"
+                ).fetchall()
+            ]
+            rows = conn.execute(
+                "SELECT rowid_out, cells FROM query ORDER BY rowid_out"
+            ).fetchall()
+            row = conn.execute(
+                "SELECT value FROM meta WHERE key = 'last_change_id'"
+            ).fetchone()
+            return cols, rows, int(row[0]) if row and row[0] is not None else 0
+        finally:
+            conn.close()
+
+    def read_catch_up(
+        self, from_id: int
+    ) -> Tuple[List[str], List[Tuple[int, str, int, str]], int]:
+        """(columns, [(id, type, rowid, cells_json)] past from_id, cutoff)."""
+        conn = self._reader()
+        try:
+            conn.execute("BEGIN")
+            cols = [
+                r[0]
+                for r in conn.execute(
+                    "SELECT name FROM columns ORDER BY idx"
+                ).fetchall()
+            ]
+            rows = conn.execute(
+                "SELECT id, type, rowid, cells FROM changes WHERE id > ? "
+                "ORDER BY id",
+                (from_id,),
+            ).fetchall()
+            cutoff = rows[-1][0] if rows else from_id
+            return cols, rows, cutoff
+        finally:
+            conn.close()
+
+    # -- main loop ---------------------------------------------------------
+
+    async def _run(self) -> None:
+        try:
+            if self.state == "restoring":
+                # anything that changed while we were down is caught by one
+                # full re-run diff (the reference replays from meta db_version)
+                self.state = "running"
+                self.ready.set()
+                await self._diff_pass({}, full_rerun=True)
+            else:
+                await self._initial_fill()
+            while True:
+                batch, full = await self._gather_candidates()
+                await self._diff_pass(batch, full)
+                if time.monotonic() - self._last_purge > PURGE_INTERVAL:
+                    await asyncio.to_thread(self._purge_changes)
+                    self._last_purge = time.monotonic()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # terminal: mark failed and wake every waiter so nothing hangs
+            # on ready.wait(); the manager deregisters failed matchers
+            logger.exception("subscription %s failed", self.id)
+            self.failed = str(e)
+            self.ready.set()
+            self._publish({"error": str(e)})
+
+    async def _gather_candidates(self) -> Tuple[Dict[str, Set[bytes]], bool]:
+        cands, full = await self._cands.get()
+        merged: Dict[str, Set[bytes]] = {
+            t: set(pks) for t, pks in cands.items()
+        }
+        deadline = asyncio.get_running_loop().time() + CANDIDATE_BATCH_WINDOW
+        total = sum(len(v) for v in merged.values())
+        while total < CANDIDATE_BATCH_MAX:
+            timeout = deadline - asyncio.get_running_loop().time()
+            if timeout <= 0:
+                break
+            try:
+                cands, f = await asyncio.wait_for(self._cands.get(), timeout)
+            except asyncio.TimeoutError:
+                break
+            full = full or f
+            for t, pks in cands.items():
+                merged.setdefault(t, set()).update(pks)
+            total = sum(len(v) for v in merged.values())
+        return merged, full
+
+    # -- initial fill ------------------------------------------------------
+
+    async def _initial_fill(self) -> None:
+        """Run the full query once and persist the result set (ref:
+        pubsub.rs:1139-1250).  Subscribers read it back via
+        ``read_snapshot`` — live events only carry changes."""
+        self.state = "filling"
+
+        def _read(conn: sqlite3.Connection):
+            cur = conn.execute(self.rewritten)
+            desc = [d[0] for d in cur.description]
+            return desc, cur.fetchall()
+
+        desc, rows = await self.pool.read_call(_read)
+        self.columns = desc[self.n_pk_cols :]
+
+        def _persist():
+            self._conn.execute("DELETE FROM columns")
+            self._conn.executemany(
+                "INSERT INTO columns (idx, name) VALUES (?, ?)",
+                list(enumerate(self.columns)),
+            )
+            rowid = 0
+            for row in rows:
+                rowid += 1
+                ident, pk_parts, cells = self._split_row(row)
+                self._conn.execute(
+                    "INSERT OR REPLACE INTO query (ident, rowid_out, cells"
+                    + "".join(f", pk_{i}" for i in range(len(pk_parts)))
+                    + ") VALUES (?, ?, ?"
+                    + ", ?" * len(pk_parts)
+                    + ")",
+                    (ident, rowid, _cells_json(cells), *pk_parts),
+                )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO meta (key, value) VALUES "
+                "('max_rowid', ?)",
+                (rowid,),
+            )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO meta (key, value) VALUES "
+                "('state', 'running')"
+            )
+            self._conn.commit()
+
+        await asyncio.to_thread(_persist)
+        self.state = "running"
+        self.ready.set()
+
+    def _split_row(
+        self, row: Sequence[SqliteValue]
+    ) -> Tuple[bytes, List[bytes], List[SqliteValue]]:
+        """(identity blob, per-table pk blobs, visible cells) from a
+        rewritten-query row."""
+        pk_parts: List[bytes] = []
+        off = 0
+        all_pks: List[SqliteValue] = []
+        for pk_cols in self.pks:
+            vals = list(row[off : off + len(pk_cols)])
+            off += len(pk_cols)
+            pk_parts.append(pack_columns(vals))
+            all_pks.extend(vals)
+        ident = pack_columns(all_pks)
+        return ident, pk_parts, list(row[off:])
+
+    # -- diff pass ---------------------------------------------------------
+
+    async def _diff_pass(
+        self, cands: Dict[str, Set[bytes]], full_rerun: bool
+    ) -> None:
+        """Re-run (restricted) and diff against the persisted query table
+        (ref: handle_candidates, pubsub.rs:1357-1616)."""
+        from ..types.columns import unpack_columns
+
+        queries: List[Tuple[str, Tuple]] = []
+        if full_rerun:
+            queries.append((self.rewritten, ()))
+        else:
+            for t_idx, ref in enumerate(self.parsed.tables):
+                pks = cands.get(ref.name)
+                if not pks:
+                    continue
+                pk_cols = self.pks[t_idx]
+                unpacked = [unpack_columns(p) for p in pks]
+                pred = sqlmod.restriction_predicate(ref, pk_cols, len(unpacked))
+                q = sqlmod.with_restriction(self.parsed, self.rewritten, pred)
+                params = tuple(v for row in unpacked for v in row)
+                queries.append((q, params))
+        if not queries:
+            return
+
+        def _read(conn: sqlite3.Connection):
+            out = {}
+            for q, params in queries:
+                for row in conn.execute(q, params):
+                    ident, pk_parts, cells = self._split_row(row)
+                    out[ident] = (pk_parts, cells)
+            return out
+
+        results: Dict[bytes, Tuple[List[bytes], List[SqliteValue]]] = (
+            await self.pool.read_call(_read)
+        )
+        events = await asyncio.to_thread(
+            self._apply_diff, results, cands, full_rerun
+        )
+        for ev in events:
+            self._publish(ev)
+
+    def _apply_diff(
+        self,
+        results: Dict[bytes, Tuple[List[bytes], List[SqliteValue]]],
+        cands: Dict[str, Set[bytes]],
+        full_rerun: bool,
+    ) -> List[dict]:
+        conn = self._conn
+        assert conn is not None
+        events: List[dict] = []
+        row = conn.execute(
+            "SELECT value FROM meta WHERE key = 'max_rowid'"
+        ).fetchone()
+        max_rowid = int(row[0]) if row and row[0] is not None else 0
+        now = time.time()
+        pk_col_names = [f"pk_{i}" for i in range(len(self.parsed.tables))]
+
+        def record(typ: str, rowid: int, cells_json: str) -> None:
+            cur = conn.execute(
+                "INSERT INTO changes (type, rowid, cells, ts) VALUES (?,?,?,?)",
+                (typ, rowid, cells_json, now),
+            )
+            self.last_change_id = cur.lastrowid
+            events.append(
+                {
+                    "change": [
+                        typ,
+                        rowid,
+                        json.loads(cells_json),
+                        self.last_change_id,
+                    ]
+                }
+            )
+
+        try:
+            # upserts: result rows that are new or whose cells changed
+            for ident, (pk_parts, cells) in results.items():
+                cj = _cells_json(cells)
+                stored = conn.execute(
+                    "SELECT rowid_out, cells FROM query WHERE ident = ?",
+                    (ident,),
+                ).fetchone()
+                if stored is None:
+                    max_rowid += 1
+                    conn.execute(
+                        "INSERT INTO query (ident, rowid_out, cells"
+                        + "".join(f", {c}" for c in pk_col_names)
+                        + ") VALUES (?,?,?"
+                        + ",?" * len(pk_parts)
+                        + ")",
+                        (ident, max_rowid, cj, *pk_parts),
+                    )
+                    record("insert", max_rowid, cj)
+                elif stored[1] != cj:
+                    conn.execute(
+                        "UPDATE query SET cells = ? WHERE ident = ?", (cj, ident)
+                    )
+                    record("update", stored[0], cj)
+
+            # deletes: stored rows hit by a candidate that vanished from the
+            # restricted result set
+            if full_rerun:
+                gone = conn.execute(
+                    "SELECT ident, rowid_out, cells FROM query"
+                ).fetchall()
+                for ident, rowid_out, cells in gone:
+                    if ident not in results:
+                        conn.execute(
+                            "DELETE FROM query WHERE ident = ?", (ident,)
+                        )
+                        record("delete", rowid_out, cells)
+            else:
+                for t_idx, ref in enumerate(self.parsed.tables):
+                    pks = cands.get(ref.name)
+                    if not pks:
+                        continue
+                    marks = ",".join("?" for _ in pks)
+                    rows = conn.execute(
+                        f"SELECT ident, rowid_out, cells FROM query "
+                        f"WHERE pk_{t_idx} IN ({marks})",
+                        tuple(pks),
+                    ).fetchall()
+                    for ident, rowid_out, cells in rows:
+                        if ident not in results:
+                            conn.execute(
+                                "DELETE FROM query WHERE ident = ?", (ident,)
+                            )
+                            record("delete", rowid_out, cells)
+
+            conn.execute(
+                "INSERT OR REPLACE INTO meta (key, value) VALUES "
+                "('max_rowid', ?)",
+                (max_rowid,),
+            )
+            conn.execute(
+                "INSERT OR REPLACE INTO meta (key, value) VALUES "
+                "('last_change_id', ?)",
+                (self.last_change_id,),
+            )
+            conn.commit()
+        except BaseException:
+            conn.rollback()
+            raise
+        return events
+
+    def _purge_changes(self) -> None:
+        """Drop old change rows beyond the retention window (ref:
+        pubsub.rs:1129)."""
+        conn = self._conn
+        assert conn is not None
+        conn.execute(
+            "DELETE FROM changes WHERE id <= "
+            "(SELECT MAX(id) FROM changes) - ?",
+            (CHANGES_RETENTION,),
+        )
+        conn.commit()

@@ -1,0 +1,334 @@
+"""SQL analysis for the subscription matcher.
+
+Equivalent of the parsing half of crates/corro-types/src/pubsub.rs
+(``Matcher::create``, pubsub.rs:509-925): given a subscription SELECT we
+must know (a) which CRR tables it reads, (b) how to give every result row a
+stable identity, and (c) how to re-run the query restricted to a set of
+candidate primary keys.
+
+The reference leans on the ``sqlite3-parser`` crate; we use a focused
+tokenizer instead — enough to find the top-level FROM clause, inject
+``alias.pk AS __corro_pk_<t>_<i>`` identity columns into the select list,
+and append a PK-membership restriction to the WHERE clause.  Tables that
+the query reads *outside* the top-level FROM (e.g. IN-subqueries) are
+discovered with SQLite's authorizer hook and trigger a full re-run diff
+instead of a restricted one — slower but always correct.
+
+Queries whose shape makes PK identity meaningless (aggregates, DISTINCT,
+compound selects, CTEs) are rejected, mirroring the reference's unsupported
+statement errors.
+"""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+from dataclasses import dataclass, field
+from typing import List, Optional, Set, Tuple
+
+
+class MatcherError(Exception):
+    pass
+
+
+# -- tokenizer -------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*\n?|/\*.*?\*/)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*"|`(?:[^`]|``)*`|\[[^\]]*\])
+  | (?P<num>\d+(?:\.\d*)?(?:[eE][+-]?\d+)?|\.\d+)
+  | (?P<word>[A-Za-z_][A-Za-z0-9_$]*)
+  | (?P<punct>\(|\)|,|\*|;|[^\sA-Za-z0-9_]+?)
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # 'str' | 'qident' | 'num' | 'word' | 'punct'
+    text: str
+    pos: int  # char offset in the source
+    depth: int  # paren depth *before* this token is applied
+
+    @property
+    def upper(self) -> str:
+        return self.text.upper() if self.kind == "word" else self.text
+
+
+def tokenize(sql: str) -> List[Token]:
+    tokens: List[Token] = []
+    pos = 0
+    depth = 0
+    n = len(sql)
+    while pos < n:
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None:
+            raise MatcherError(f"cannot tokenize SQL at offset {pos}: {sql[pos:pos+20]!r}")
+        pos = m.end()
+        if m.lastgroup == "ws":
+            continue
+        text = m.group()
+        tokens.append(Token(kind=m.lastgroup, text=text, pos=m.start(), depth=depth))
+        if text == "(":
+            depth += 1
+        elif text == ")":
+            depth -= 1
+            if depth < 0:
+                raise MatcherError("unbalanced parentheses in SQL")
+    if depth != 0:
+        raise MatcherError("unbalanced parentheses in SQL")
+    return tokens
+
+
+def unquote_ident(text: str) -> str:
+    if text and text[0] == '"' and text[-1] == '"':
+        return text[1:-1].replace('""', '"')
+    if text and text[0] == "`" and text[-1] == "`":
+        return text[1:-1].replace("``", "`")
+    if text and text[0] == "[" and text[-1] == "]":
+        return text[1:-1]
+    return text
+
+
+def quote_ident(name: str) -> str:
+    return '"' + name.replace('"', '""') + '"'
+
+
+def normalize_sql(sql: str) -> str:
+    """Canonical form used to dedup subscriptions (ref: normalize_sql,
+    pubsub.rs:2171): comments stripped, whitespace collapsed, keywords
+    uppercased, trailing semicolon dropped."""
+    out: List[str] = []
+    for tok in tokenize(sql):
+        if tok.text == ";":
+            continue
+        out.append(tok.upper if tok.kind == "word" else tok.text)
+    return " ".join(out)
+
+
+# -- SELECT shape analysis -------------------------------------------------
+
+_JOIN_WORDS = {
+    "JOIN", "LEFT", "RIGHT", "FULL", "INNER", "OUTER", "CROSS", "NATURAL",
+}
+_FROM_END_WORDS = {"WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "WINDOW"}
+_ALIAS_STOP_WORDS = _JOIN_WORDS | _FROM_END_WORDS | {"ON", "USING", "AS"}
+
+
+@dataclass
+class TableRef:
+    name: str
+    alias: str  # == name when not aliased
+
+
+@dataclass
+class ParsedSelect:
+    sql: str  # original text (sans trailing semicolon)
+    tables: List[TableRef] = field(default_factory=list)
+    select_insert: int = 0  # char offset right after SELECT
+    where_insert: int = 0  # char offset where a WHERE/AND clause can go
+    has_where: bool = False
+    where_clause_start: int = 0  # offset of first token after WHERE
+    # OUTER joins NULL-extend rows, so a per-table PK restriction can't see
+    # which stored rows to retract — such queries must diff via full re-run
+    has_outer_join: bool = False
+
+
+def parse_select(sql: str) -> ParsedSelect:
+    sql = sql.strip().rstrip(";").strip()
+    tokens = tokenize(sql)
+    if not tokens or tokens[0].upper != "SELECT":
+        raise MatcherError("subscriptions must be SELECT statements")
+
+    parsed = ParsedSelect(sql=sql)
+    parsed.select_insert = tokens[0].pos + len(tokens[0].text)
+
+    i = 1
+    if i < len(tokens) and tokens[i].upper in ("DISTINCT", "ALL"):
+        if tokens[i].upper == "DISTINCT":
+            raise MatcherError("DISTINCT queries are not supported for subscriptions")
+        i += 1
+
+    top = [t for t in tokens if t.depth == 0]
+    for t in top:
+        if t.kind != "word":
+            continue
+        u = t.upper
+        if u in ("UNION", "INTERSECT", "EXCEPT"):
+            raise MatcherError("compound SELECTs are not supported for subscriptions")
+        if u == "GROUP":
+            raise MatcherError("GROUP BY queries are not supported for subscriptions")
+        if u == "HAVING":
+            raise MatcherError("HAVING queries are not supported for subscriptions")
+    if tokens[0].pos != 0 or tokens[0].upper != "SELECT":
+        raise MatcherError("subscriptions must be a single SELECT statement")
+    if top and top[0].upper == "WITH":
+        raise MatcherError("CTEs are not supported for subscriptions")
+
+    # locate top-level FROM
+    from_idx: Optional[int] = None
+    for idx, t in enumerate(tokens):
+        if t.depth == 0 and t.upper == "FROM":
+            from_idx = idx
+            break
+    if from_idx is None:
+        raise MatcherError("subscription SELECT must have a FROM clause")
+
+    # parse table refs until a FROM-terminating keyword at depth 0
+    i = from_idx + 1
+    end_idx = len(tokens)
+    expecting_table = True
+    while i < len(tokens):
+        t = tokens[i]
+        if t.depth == 0 and t.kind == "word" and t.upper in _FROM_END_WORDS:
+            end_idx = i
+            break
+        if t.depth > 0:
+            i += 1
+            continue
+        if expecting_table:
+            if t.text == "(":
+                raise MatcherError(
+                    "subqueries in FROM are not supported for subscriptions"
+                )
+            if t.kind not in ("word", "qident") or (
+                t.kind == "word" and t.upper in _JOIN_WORDS
+            ):
+                raise MatcherError(f"cannot parse FROM clause near {t.text!r}")
+            name = unquote_ident(t.text)
+            alias = name
+            # optional [AS] alias
+            j = i + 1
+            if j < len(tokens) and tokens[j].depth == 0:
+                nt = tokens[j]
+                if nt.kind == "word" and nt.upper == "AS":
+                    j += 1
+                    if j >= len(tokens):
+                        raise MatcherError("dangling AS in FROM clause")
+                    alias = unquote_ident(tokens[j].text)
+                    j += 1
+                elif (
+                    nt.kind == "qident"
+                    or (nt.kind == "word" and nt.upper not in _ALIAS_STOP_WORDS)
+                ):
+                    alias = unquote_ident(nt.text)
+                    j += 1
+            if "." in name:
+                raise MatcherError("schema-qualified tables are not supported")
+            parsed.tables.append(TableRef(name=name, alias=alias))
+            expecting_table = False
+            i = j
+            continue
+        # between table refs: skip join connectors / ON expressions / commas
+        if t.text == ",":
+            expecting_table = True
+        elif t.kind == "word" and t.upper == "JOIN":
+            expecting_table = True
+        elif t.kind == "word" and t.upper in ("LEFT", "RIGHT", "FULL", "OUTER"):
+            parsed.has_outer_join = True
+        i += 1
+
+    if not parsed.tables:
+        raise MatcherError("subscription SELECT must read at least one table")
+
+    # WHERE position: first top-level WHERE token, else before ORDER/LIMIT/end
+    where_tok: Optional[Token] = None
+    tail_tok: Optional[Token] = None
+    for t in tokens[end_idx:]:
+        if t.depth != 0 or t.kind != "word":
+            continue
+        if t.upper == "WHERE" and where_tok is None:
+            where_tok = t
+        if t.upper in ("ORDER", "LIMIT", "WINDOW") and tail_tok is None:
+            tail_tok = t
+    if where_tok is not None:
+        parsed.has_where = True
+        parsed.where_clause_start = where_tok.pos + len(where_tok.text)
+        parsed.where_insert = tail_tok.pos if tail_tok is not None else len(sql)
+    else:
+        parsed.where_insert = tail_tok.pos if tail_tok is not None else len(sql)
+    return parsed
+
+
+# -- rewriting -------------------------------------------------------------
+
+PK_PREFIX = "__corro_pk"
+
+
+def pk_alias(table_idx: int, pk_idx: int) -> str:
+    return f"{PK_PREFIX}_{table_idx}_{pk_idx}"
+
+
+def rewrite_with_pks(
+    parsed: ParsedSelect, pks: List[List[str]]
+) -> str:
+    """Inject identity columns: ``SELECT <pk aliases>, <orig list> FROM …``
+    (ref: the per-table PK-aliased rewritten queries, pubsub.rs:688-750)."""
+    cols = []
+    for t_idx, (ref, pk_cols) in enumerate(zip(parsed.tables, pks)):
+        for p_idx, pk in enumerate(pk_cols):
+            cols.append(
+                f"{quote_ident(ref.alias)}.{quote_ident(pk)} AS "
+                f"{pk_alias(t_idx, p_idx)}"
+            )
+    head = parsed.sql[: parsed.select_insert]
+    tail = parsed.sql[parsed.select_insert :]
+    return f"{head} {', '.join(cols)}, {tail.lstrip()}"
+
+
+def restriction_predicate(
+    ref: TableRef, pk_cols: List[str], n_rows: int
+) -> str:
+    """Build ``(alias.pk1, alias.pk2) IN (VALUES (?,?),…)`` for one table."""
+    alias = quote_ident(ref.alias)
+    lhs_cols = [f"{alias}.{quote_ident(c)}" for c in pk_cols]
+    row = "(" + ", ".join("?" for _ in pk_cols) + ")"
+    values = ", ".join(row for _ in range(n_rows))
+    if len(pk_cols) == 1:
+        return f"{lhs_cols[0]} IN (VALUES {values})"
+    return f"({', '.join(lhs_cols)}) IN (VALUES {values})"
+
+
+def with_restriction(parsed: ParsedSelect, rewritten: str, predicate: str) -> str:
+    """Append a PK restriction to the rewritten query's WHERE clause.
+
+    The rewritten query differs from ``parsed.sql`` only by an insertion at
+    ``select_insert``, so all offsets past it shift by a constant.
+    """
+    shift = len(rewritten) - len(parsed.sql)
+    if parsed.has_where:
+        start = parsed.where_clause_start + shift
+        end = parsed.where_insert + shift
+        clause = rewritten[start:end].strip()
+        return (
+            rewritten[:start]
+            + f" ({clause}) AND {predicate} "
+            + rewritten[end:]
+        )
+    insert = parsed.where_insert + shift
+    return rewritten[:insert] + f" WHERE {predicate} " + rewritten[insert:]
+
+
+# -- referenced-table discovery via the authorizer -------------------------
+
+def referenced_tables(conn: sqlite3.Connection, sql: str) -> Set[str]:
+    """Every table the statement reads, per SQLite's own compiler (the
+    authorizer hook fires SQLITE_READ during prepare) — catches tables in
+    subqueries the FROM-clause parser doesn't see."""
+    tables: Set[str] = set()
+
+    def authorizer(action, arg1, arg2, dbname, trigger):
+        if action == sqlite3.SQLITE_READ and arg1:
+            tables.add(arg1)
+        return sqlite3.SQLITE_OK
+
+    conn.set_authorizer(authorizer)
+    try:
+        # prepare-only: LIMIT 0 still compiles the full statement
+        conn.execute(f"SELECT * FROM ({sql}) LIMIT 0").fetchall()
+    finally:
+        conn.set_authorizer(None)
+    return tables

@@ -26,6 +26,15 @@ SqliteValue = Union[None, int, float, str, bytes]
 MAX_CHANGES_BYTE_SIZE = 8 * 1024  # ref: change.rs:116
 
 
+def jsonify_cell(v: SqliteValue):
+    """JSON wire form of one SQLite value — blobs become {"blob": hex}
+    (JSON has no binary type).  Shared by the query API and the
+    subscription event stream so the two can't drift."""
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return {"blob": bytes(v).hex()}
+    return v
+
+
 def value_byte_size(val: SqliteValue) -> int:
     """Wire-size estimate of a value (ref: corro-api-types lib.rs:558-566)."""
     if val is None:

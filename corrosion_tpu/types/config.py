@@ -22,6 +22,18 @@ class DbConfig:
     path: str = "corrosion.db"
     schema_paths: List[str] = field(default_factory=list)
     read_conns: int = 4
+    # subscription state directory (ref: config.rs subscriptions_path);
+    # default: "<db dir>/subscriptions" when the DB is file-backed
+    subscriptions_path: Optional[str] = None
+
+    def resolved_subscriptions_path(self) -> Optional[str]:
+        if self.subscriptions_path is not None:
+            return self.subscriptions_path
+        if self.path == ":memory:":
+            return None
+        import os.path
+
+        return os.path.join(os.path.dirname(os.path.abspath(self.path)), "subscriptions")
 
 
 @dataclass

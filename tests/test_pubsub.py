@@ -1,0 +1,369 @@
+"""Subscription engine tests (ref: pubsub matcher tests at the bottom of
+crates/corro-types/src/pubsub.rs and the HTTP endpoint behavior in
+crates/corro-agent/src/api/public/pubsub.rs)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import ClientSession
+
+from corrosion_tpu.agent import Agent, AgentConfig, make_broadcastable_changes
+from corrosion_tpu.api.http import Api
+from corrosion_tpu.pubsub import MatcherError, SubsManager, normalize_sql
+from corrosion_tpu.pubsub import matcher as matcher_mod
+from corrosion_tpu.pubsub.sql import parse_select
+from corrosion_tpu.types.schema import apply_schema
+
+SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, "
+    'text TEXT NOT NULL DEFAULT "");'
+    "CREATE TABLE buddies (id INTEGER NOT NULL PRIMARY KEY, "
+    'buddy TEXT NOT NULL DEFAULT "");'
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def fast_batching(monkeypatch):
+    """Shrink the candidate aggregation window so tests run quickly."""
+    monkeypatch.setattr(matcher_mod, "CANDIDATE_BATCH_WINDOW", 0.05)
+
+
+# ---------------------------------------------------------------------------
+# SQL analysis (ref: Matcher::create parsing, pubsub.rs:509-750)
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_sql():
+    a = normalize_sql("select  id , text\nFROM tests  -- comment\n;")
+    b = normalize_sql("SELECT id, text FROM tests")
+    assert a == b
+    assert normalize_sql("SELECT 'a  b' FROM t") != normalize_sql("SELECT 'a b' FROM t")
+
+
+def test_parse_select_tables_and_aliases():
+    p = parse_select("SELECT t.id FROM tests t JOIN buddies AS b ON b.id = t.id")
+    assert [(r.name, r.alias) for r in p.tables] == [("tests", "t"), ("buddies", "b")]
+    p = parse_select('SELECT id FROM "tests" WHERE id > 3 ORDER BY id')
+    assert p.tables[0].name == "tests"
+    assert p.has_where
+
+
+def test_parse_select_rejections():
+    with pytest.raises(MatcherError, match="DISTINCT"):
+        parse_select("SELECT DISTINCT id FROM tests")
+    with pytest.raises(MatcherError, match="GROUP BY"):
+        parse_select("SELECT count(*) FROM tests GROUP BY text")
+    with pytest.raises(MatcherError, match="compound"):
+        parse_select("SELECT id FROM tests UNION SELECT id FROM buddies")
+    with pytest.raises(MatcherError, match="SELECT"):
+        parse_select("INSERT INTO tests VALUES (1, 'x')")
+    with pytest.raises(MatcherError, match="subqueries in FROM"):
+        parse_select("SELECT x FROM (SELECT id AS x FROM tests)")
+
+
+# ---------------------------------------------------------------------------
+# matcher end-to-end against an agent store
+# ---------------------------------------------------------------------------
+
+
+async def boot(tmp_path):
+    agent = Agent(AgentConfig(db_path=":memory:", read_conns=2)).open_sync()
+    await agent.pool.write_call(lambda c: apply_schema(c, SCHEMA))
+    subs = SubsManager(str(tmp_path / "subs"), agent.pool)
+    subs.start()
+    return agent, subs
+
+
+async def write(agent, subs, sql, params=()):
+    outcome = await make_broadcastable_changes(agent, [(sql, params)])
+    subs.match_changes([(c.actor_id, c.changeset) for c in outcome.changesets])
+    return outcome
+
+
+async def next_event(sub, timeout=5.0):
+    return await asyncio.wait_for(sub.queue.get(), timeout)
+
+
+def test_matcher_insert_update_delete(tmp_path):
+    async def main():
+        agent, subs = await boot(tmp_path)
+        await write(agent, subs, "INSERT INTO tests (id, text) VALUES (1, 'one')")
+
+        matcher, created = await subs.get_or_insert(
+            "SELECT id, text FROM tests"
+        )
+        assert created
+        await asyncio.wait_for(matcher.ready.wait(), 5)
+        cols, rows, cutoff = matcher.read_snapshot()
+        assert cols == ["id", "text"]
+        assert [json.loads(r[1]) for r in rows] == [[1, "one"]]
+
+        sub = matcher.attach()
+        # insert
+        await write(agent, subs, "INSERT INTO tests (id, text) VALUES (2, 'two')")
+        ev = await next_event(sub)
+        typ, rowid, cells, change_id = ev["change"]
+        assert (typ, cells) == ("insert", [2, "two"])
+        assert change_id == 1
+        # update
+        await write(agent, subs, "UPDATE tests SET text = 'TWO' WHERE id = 2")
+        ev = await next_event(sub)
+        assert ev["change"][0] == "update"
+        assert ev["change"][1] == rowid
+        assert ev["change"][2] == [2, "TWO"]
+        assert ev["change"][3] == 2
+        # delete
+        await write(agent, subs, "DELETE FROM tests WHERE id = 2")
+        ev = await next_event(sub)
+        assert ev["change"][0] == "delete"
+        assert ev["change"][1] == rowid
+        assert ev["change"][3] == 3
+        # a write not matching the WHERE of a filtered sub still diffs fine
+        await subs.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_matcher_where_filter_and_dedup(tmp_path):
+    async def main():
+        agent, subs = await boot(tmp_path)
+        m1, created1 = await subs.get_or_insert(
+            "SELECT id, text FROM tests WHERE id >= 10"
+        )
+        m2, created2 = await subs.get_or_insert(
+            "select id,  text from tests where id >= 10"
+        )
+        assert created1 and not created2 and m1 is m2
+
+        await asyncio.wait_for(m1.ready.wait(), 5)
+        sub = m1.attach()
+        # below the filter: no event
+        await write(agent, subs, "INSERT INTO tests (id, text) VALUES (1, 'lo')")
+        # above the filter: event
+        await write(agent, subs, "INSERT INTO tests (id, text) VALUES (10, 'hi')")
+        ev = await next_event(sub)
+        assert ev["change"][0] == "insert"
+        assert ev["change"][2] == [10, "hi"]
+        # moving a row out of the filter is a delete
+        await write(agent, subs, "UPDATE tests SET id = 2 WHERE id = 10")
+        seen = {(await next_event(sub))["change"][0]}
+        # pk update = delete(10) (+ insert(2) filtered out)
+        assert "delete" in seen
+        await subs.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_matcher_join_query(tmp_path):
+    async def main():
+        agent, subs = await boot(tmp_path)
+        await write(agent, subs, "INSERT INTO tests (id, text) VALUES (1, 'a')")
+        await write(agent, subs, "INSERT INTO buddies (id, buddy) VALUES (1, 'bud')")
+
+        m, _ = await subs.get_or_insert(
+            "SELECT t.text, b.buddy FROM tests t JOIN buddies b ON b.id = t.id"
+        )
+        await asyncio.wait_for(m.ready.wait(), 5)
+        _, rows, _ = m.read_snapshot()
+        assert [json.loads(r[1]) for r in rows] == [["a", "bud"]]
+
+        sub = m.attach()
+        # changing the joined row updates the result
+        await write(agent, subs, "UPDATE buddies SET buddy = 'pal' WHERE id = 1")
+        ev = await next_event(sub)
+        assert ev["change"][0] == "update"
+        assert ev["change"][2] == ["a", "pal"]
+        # removing the buddy removes the join row
+        await write(agent, subs, "DELETE FROM buddies WHERE id = 1")
+        ev = await next_event(sub)
+        assert ev["change"][0] == "delete"
+        await subs.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_matcher_left_join_null_extension(tmp_path):
+    """OUTER joins must diff via full re-run: the NULL-extended row has no
+    candidate PK to retract it by (regression for the per-table restriction
+    shortcut)."""
+
+    async def main():
+        agent, subs = await boot(tmp_path)
+        await write(agent, subs, "INSERT INTO tests (id, text) VALUES (1, 'a')")
+
+        m, _ = await subs.get_or_insert(
+            "SELECT t.text, b.buddy FROM tests t LEFT JOIN buddies b ON b.id = t.id"
+        )
+        await asyncio.wait_for(m.ready.wait(), 5)
+        _, rows, _ = m.read_snapshot()
+        assert [json.loads(r[1]) for r in rows] == [["a", None]]
+
+        sub = m.attach()
+        # the NULL-extended row must flip to the joined row, not duplicate
+        await write(agent, subs, "INSERT INTO buddies (id, buddy) VALUES (1, 'bud')")
+        evs = [(await next_event(sub))["change"] for _ in range(2)]
+        types = sorted(e[0] for e in evs)
+        assert types == ["delete", "insert"]
+        _, rows, _ = await asyncio.to_thread(m.read_snapshot)
+        assert [json.loads(r[1]) for r in rows] == [["a", "bud"]]
+
+        # and back: deleting the buddy resurrects the NULL-extended row
+        await write(agent, subs, "DELETE FROM buddies WHERE id = 1")
+        evs = [(await next_event(sub))["change"] for _ in range(2)]
+        assert sorted(e[0] for e in evs) == ["delete", "insert"]
+        _, rows, _ = await asyncio.to_thread(m.read_snapshot)
+        assert [json.loads(r[1]) for r in rows] == [["a", None]]
+        await subs.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_matcher_rejects_non_crr(tmp_path):
+    async def main():
+        agent, subs = await boot(tmp_path)
+        with pytest.raises(MatcherError, match="not a CRR"):
+            await subs.get_or_insert("SELECT * FROM sqlite_master")
+        await subs.stop()
+        agent.close()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints (ref: api/public/pubsub.rs)
+# ---------------------------------------------------------------------------
+
+
+async def boot_http(tmp_path):
+    agent, subs = await boot(tmp_path)
+    api = Api(agent, subs=subs)
+    port = await api.start()
+    return agent, subs, api, f"http://127.0.0.1:{port}"
+
+
+async def read_lines(resp, n, timeout=5.0):
+    out = []
+    for _ in range(n):
+        line = await asyncio.wait_for(resp.content.readline(), timeout)
+        assert line, "stream closed early"
+        out.append(json.loads(line))
+    return out
+
+
+def test_http_subscription_stream(tmp_path):
+    async def main():
+        agent, subs, api, base = await boot_http(tmp_path)
+        async with ClientSession() as http:
+            await http.post(
+                f"{base}/v1/transactions",
+                json=["INSERT INTO tests (id, text) VALUES (1, 'one')"],
+            )
+            resp = await http.post(
+                f"{base}/v1/subscriptions", json="SELECT id, text FROM tests"
+            )
+            assert resp.status == 200
+            sub_id = resp.headers["corro-query-id"]
+            lines = await read_lines(resp, 3)
+            assert lines[0] == {"columns": ["id", "text"]}
+            assert lines[1] == {"row": [1, [1, "one"]]}
+            assert "eoq" in lines[2]
+
+            # a write should arrive as a live change event
+            await http.post(
+                f"{base}/v1/transactions",
+                json=["INSERT INTO tests (id, text) VALUES (2, 'two')"],
+            )
+            (ev,) = await read_lines(resp, 1)
+            assert ev["change"][0] == "insert"
+            assert ev["change"][2] == [2, "two"]
+            first_change_id = ev["change"][3]
+            resp.close()
+
+            # catch-up from the last seen change id: re-attach by id
+            await http.post(
+                f"{base}/v1/transactions",
+                json=["INSERT INTO tests (id, text) VALUES (3, 'three')"],
+            )
+            await asyncio.sleep(0.3)  # let the matcher diff
+            resp = await http.get(
+                f"{base}/v1/subscriptions/{sub_id}",
+                params={"from": str(first_change_id)},
+            )
+            assert resp.status == 200
+            (ev,) = await read_lines(resp, 1)
+            assert ev["change"][2] == [3, "three"]
+            assert ev["change"][3] == first_change_id + 1
+            resp.close()
+
+            # skip_rows: no row events, straight to eoq
+            resp = await http.get(
+                f"{base}/v1/subscriptions/{sub_id}",
+                params={"skip_rows": "true"},
+            )
+            lines = await read_lines(resp, 2)
+            assert lines[0] == {"columns": ["id", "text"]}
+            assert "eoq" in lines[1]
+            resp.close()
+
+            # unknown sub 404s
+            resp = await http.get(f"{base}/v1/subscriptions/nope")
+            assert resp.status == 404
+            # bad statements 400
+            resp = await http.post(
+                f"{base}/v1/subscriptions", json="SELECT DISTINCT id FROM tests"
+            )
+            assert resp.status == 400
+        await subs.stop()
+        await api.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_subscription_restore(tmp_path):
+    """Subscriptions persist in their own DB and restore on boot
+    (ref: pubsub.rs:773-809 + run_root.rs:229-282)."""
+
+    async def main():
+        db_path = str(tmp_path / "store.db")
+        agent = Agent(AgentConfig(db_path=db_path, read_conns=2)).open_sync()
+        await agent.pool.write_call(lambda c: apply_schema(c, SCHEMA))
+        subs = SubsManager(str(tmp_path / "subs"), agent.pool)
+        subs.start()
+        m, _ = await subs.get_or_insert("SELECT id, text FROM tests")
+        sub_id = m.id
+        await asyncio.wait_for(m.ready.wait(), 5)
+        await write(agent, subs, "INSERT INTO tests (id, text) VALUES (1, 'a')")
+        await asyncio.sleep(0.3)
+        await subs.stop()
+
+        # while "down", another write lands in the store
+        await make_broadcastable_changes(
+            agent, [("INSERT INTO tests (id, text) VALUES (2, 'b')", ())]
+        )
+
+        subs2 = SubsManager(str(tmp_path / "subs"), agent.pool)
+        assert await subs2.restore() == 1
+        m2 = subs2.get(sub_id)
+        assert m2 is not None
+        await asyncio.wait_for(m2.ready.wait(), 5)
+        # the restore full-rerun diff catches the missed write
+        for _ in range(50):
+            _, rows, _ = await asyncio.to_thread(m2.read_snapshot)
+            if len(rows) == 2:
+                break
+            await asyncio.sleep(0.1)
+        assert [json.loads(r[1]) for r in rows] == [[1, "a"], [2, "b"]]
+        await subs2.stop()
+        agent.close()
+
+    run(main())

@@ -1,0 +1,256 @@
+"""Client library for the corrosion HTTP API.
+
+Equivalent of crates/corro-client/ (``CorrosionApiClient``,
+lib.rs:19-307): ``execute`` (POST /v1/transactions), streaming ``query``
+(POST /v1/queries → QueryStream), ``schema``/``schema_from_paths``
+(POST /v1/migrations), and resumable subscriptions (``subscribe`` /
+``subscription`` → :class:`SubscriptionStream` in ``client/sub.py`` with
+auto-reconnect + ``from=last_change_id`` resume and MissedChange gap
+detection, sub.rs:57-150). ``CorrosionClient`` additionally opens a local
+read pool over the node's SQLite file (lib.rs:310-337).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+from typing import Any, AsyncIterator, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import aiohttp
+
+from .sub import MissedChange, SubscriptionStream
+
+__all__ = [
+    "ClientError",
+    "CorrosionApiClient",
+    "CorrosionClient",
+    "MissedChange",
+    "QueryStream",
+    "SubscriptionStream",
+]
+
+
+class ClientError(Exception):
+    """An API-level error (non-2xx response or error event)."""
+
+
+def _encode_statement(sql: str, params: Any = None) -> Any:
+    if not params:
+        return sql
+    if isinstance(params, dict):
+        return {"query": sql, "named_params": params}
+    return [sql, list(params)]
+
+
+def _encode_statements(
+    statements: Iterable[Any],
+) -> List[Any]:
+    out: List[Any] = []
+    for s in statements:
+        if isinstance(s, str):
+            out.append(s)
+        elif isinstance(s, tuple) and len(s) == 2 and isinstance(s[0], str):
+            out.append(_encode_statement(s[0], s[1]))
+        else:
+            out.append(s)  # pre-encoded JSON shape
+    return out
+
+
+class QueryStream:
+    """Streaming NDJSON query events (ref: corro-client QueryStream).
+
+    Iterate with ``async for event in stream`` to get raw event dicts, or
+    use :meth:`rows` to get just the row cell lists. ``columns`` is
+    populated once the first event arrives.
+    """
+
+    def __init__(self, resp: aiohttp.ClientResponse) -> None:
+        self._resp = resp
+        self.columns: Optional[List[str]] = None
+        self.eoq_time: Optional[float] = None
+
+    def __aiter__(self) -> AsyncIterator[Dict[str, Any]]:
+        return self._events()
+
+    async def _events(self) -> AsyncIterator[Dict[str, Any]]:
+        try:
+            async for line in self._resp.content:
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                if "columns" in event:
+                    self.columns = event["columns"]
+                elif "eoq" in event:
+                    self.eoq_time = event["eoq"].get("time")
+                yield event
+        finally:
+            self._resp.release()
+
+    async def rows(self) -> AsyncIterator[List[Any]]:
+        async for event in self:
+            if "row" in event:
+                yield event["row"][1]
+            elif "error" in event:
+                raise ClientError(event["error"])
+
+    async def collect(self) -> Tuple[List[str], List[List[Any]]]:
+        """Drain the stream into (columns, rows)."""
+        rows = []
+        async for cells in self.rows():
+            rows.append(cells)
+        return self.columns or [], rows
+
+
+class CorrosionApiClient:
+    """HTTP client for one corrosion node's public API."""
+
+    def __init__(
+        self,
+        base_url: str,
+        token: Optional[str] = None,
+        session: Optional[aiohttp.ClientSession] = None,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self._session = session
+        self._owned_session = session is None
+
+    async def __aenter__(self) -> "CorrosionApiClient":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    @property
+    def session(self) -> aiohttp.ClientSession:
+        if self._session is not None and self._session.closed:
+            if not self._owned_session:
+                raise ClientError("the provided ClientSession is closed")
+            self._session = None
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+            self._owned_session = True
+        return self._session
+
+    async def close(self) -> None:
+        if self._owned_session and self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def _headers(self) -> Dict[str, str]:
+        h = {"Content-Type": "application/json"}
+        if self.token is not None:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    # -- writes ------------------------------------------------------------
+
+    async def execute(self, statements: Sequence[Any]) -> Dict[str, Any]:
+        """POST /v1/transactions (ref: corro-client execute)."""
+        async with self.session.post(
+            f"{self.base_url}/v1/transactions",
+            json=_encode_statements(statements),
+            headers=self._headers(),
+        ) as resp:
+            body = await resp.json()
+            if resp.status >= 400:
+                raise ClientError(body.get("error", f"HTTP {resp.status}"))
+            return body
+
+    # -- reads -------------------------------------------------------------
+
+    async def query(self, sql: str, params: Any = None) -> QueryStream:
+        """POST /v1/queries, returning a stream (ref: corro-client query)."""
+        resp = await self.session.post(
+            f"{self.base_url}/v1/queries",
+            json=_encode_statement(sql, params),
+            headers=self._headers(),
+        )
+        if resp.status >= 400:
+            body = await resp.json()
+            resp.release()
+            raise ClientError(body.get("error", f"HTTP {resp.status}"))
+        return QueryStream(resp)
+
+    async def query_rows(
+        self, sql: str, params: Any = None
+    ) -> Tuple[List[str], List[List[Any]]]:
+        stream = await self.query(sql, params)
+        return await stream.collect()
+
+    async def table_stats(self) -> Dict[str, int]:
+        async with self.session.post(
+            f"{self.base_url}/v1/table_stats", headers=self._headers()
+        ) as resp:
+            body = await resp.json()
+            if resp.status >= 400:
+                raise ClientError(body.get("error", f"HTTP {resp.status}"))
+            return body.get("tables", {})
+
+    # -- schema ------------------------------------------------------------
+
+    async def schema(self, statements: Sequence[str]) -> Dict[str, Any]:
+        """POST /v1/migrations (ref: corro-client schema)."""
+        async with self.session.post(
+            f"{self.base_url}/v1/migrations",
+            json=list(statements),
+            headers=self._headers(),
+        ) as resp:
+            body = await resp.json()
+            if resp.status >= 400:
+                raise ClientError(body.get("error", f"HTTP {resp.status}"))
+            return body
+
+    async def schema_from_paths(self, paths: Sequence[str]) -> Dict[str, Any]:
+        """Apply schema files (ref: corro-client schema_from_paths)."""
+        statements = []
+        for path in paths:
+            with open(path) as f:
+                statements.append(f.read())
+        return await self.schema(statements)
+
+    # -- subscriptions -----------------------------------------------------
+
+    def subscribe(
+        self,
+        sql: str,
+        from_id: Optional[int] = None,
+        skip_rows: bool = False,
+    ) -> SubscriptionStream:
+        """Open (or re-attach by normalized SQL to) a subscription
+        (ref: corro-client subscribe)."""
+        return SubscriptionStream(
+            self, sql=sql, from_id=from_id, skip_rows=skip_rows
+        )
+
+    def subscription(
+        self,
+        sub_id: str,
+        from_id: Optional[int] = None,
+        skip_rows: bool = False,
+    ) -> SubscriptionStream:
+        """Re-attach to a known subscription id (ref: corro-client
+        subscription)."""
+        return SubscriptionStream(
+            self, sub_id=sub_id, from_id=from_id, skip_rows=skip_rows
+        )
+
+
+class CorrosionClient(CorrosionApiClient):
+    """API client + a local SQLite read pool (ref: corro-client
+    lib.rs:310-337): reads go straight to the node's DB file, writes go
+    over HTTP."""
+
+    def __init__(
+        self, base_url: str, db_path: str, token: Optional[str] = None
+    ) -> None:
+        super().__init__(base_url, token=token)
+        self.db_path = db_path
+
+    def read_conn(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(
+            f"file:{self.db_path}?mode=ro", uri=True, check_same_thread=False
+        )
+        conn.execute("PRAGMA query_only = 1")
+        return conn

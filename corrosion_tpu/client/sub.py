@@ -1,0 +1,175 @@
+"""Resumable subscription stream.
+
+Equivalent of crates/corro-client/src/sub.rs: ``SubscriptionStream`` keeps
+the subscription id from the ``corro-query-id`` response header, tracks the
+last observed change id, auto-reconnects on transport errors with
+``from=<last_change_id>`` resume (sub.rs:57-138), and raises
+:class:`MissedChange` when change ids arrive non-contiguous — meaning the
+server purged history past our resume point and a fresh snapshot is needed
+(sub.rs:139-150).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, AsyncIterator, Dict, Optional
+
+import aiohttp
+
+QUERY_ID_HEADER = "corro-query-id"
+RECONNECT_BACKOFF_MIN = 0.1
+RECONNECT_BACKOFF_MAX = 5.0
+
+
+class MissedChange(Exception):
+    """A gap in change ids: events were purged before we resumed
+    (ref: sub.rs MissedChange on non-contiguous ids)."""
+
+    def __init__(self, expected: int, got: int) -> None:
+        super().__init__(f"missed change: expected id {expected}, got {got}")
+        self.expected = expected
+        self.got = got
+
+
+class SubscriptionStream:
+    """Async iterator over subscription NDJSON events with auto-resume.
+
+    Yields raw event dicts (``columns`` / ``row`` / ``eoq`` / ``change``).
+    ``sub_id`` and ``last_change_id`` are live attributes the caller can
+    persist to resume later in a new stream.
+    """
+
+    def __init__(
+        self,
+        client,  # CorrosionApiClient (import cycle)
+        sql: Optional[str] = None,
+        sub_id: Optional[str] = None,
+        from_id: Optional[int] = None,
+        skip_rows: bool = False,
+        max_reconnects: Optional[int] = None,
+    ) -> None:
+        if sql is None and sub_id is None:
+            raise ValueError("either sql or sub_id is required")
+        self._client = client
+        self.sql = sql
+        self.sub_id = sub_id
+        self.last_change_id: Optional[int] = from_id
+        self.skip_rows = skip_rows
+        self.max_reconnects = max_reconnects
+        self._resp: Optional[aiohttp.ClientResponse] = None
+
+    # -- connection management --------------------------------------------
+
+    async def _connect(self) -> aiohttp.ClientResponse:
+        params: Dict[str, str] = {}
+        if self.last_change_id is not None:
+            params["from"] = str(self.last_change_id)
+        if self.skip_rows:
+            params["skip_rows"] = "true"
+        session = self._client.session
+        headers = self._client._headers()
+        if self.sub_id is not None:
+            resp = await session.get(
+                f"{self._client.base_url}/v1/subscriptions/{self.sub_id}",
+                params=params,
+                headers=headers,
+            )
+        else:
+            resp = await session.post(
+                f"{self._client.base_url}/v1/subscriptions",
+                params=params,
+                json=self.sql,
+                headers=headers,
+            )
+        if resp.status >= 400:
+            from . import ClientError
+
+            try:
+                body = await resp.json()
+            except Exception:
+                body = {}
+            resp.release()
+            raise ClientError(body.get("error", f"HTTP {resp.status}"))
+        self.sub_id = resp.headers.get(QUERY_ID_HEADER, self.sub_id)
+        return resp
+
+    async def close(self) -> None:
+        if self._resp is not None:
+            self._resp.release()
+            self._resp = None
+
+    # -- iteration ---------------------------------------------------------
+
+    def __aiter__(self) -> AsyncIterator[Dict[str, Any]]:
+        return self._events()
+
+    async def _events(self) -> AsyncIterator[Dict[str, Any]]:
+        reconnects = 0
+        backoff = RECONNECT_BACKOFF_MIN
+        while True:
+            try:
+                self._resp = await self._connect()
+            except aiohttp.ClientConnectionError:
+                # server not reachable (yet); retry like a drop (sub.rs
+                # reconnects with backoff on transport errors)
+                if (
+                    self.max_reconnects is not None
+                    and reconnects >= self.max_reconnects
+                ):
+                    raise
+                reconnects += 1
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, RECONNECT_BACKOFF_MAX)
+                continue
+            backoff = RECONNECT_BACKOFF_MIN
+            try:
+                async for line in self._resp.content:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    event = json.loads(line)
+                    if "change" in event:
+                        change_id = event["change"][3]
+                        if (
+                            self.last_change_id is not None
+                            and change_id > self.last_change_id + 1
+                        ):
+                            raise MissedChange(
+                                self.last_change_id + 1, change_id
+                            )
+                        self.last_change_id = change_id
+                    elif "eoq" in event:
+                        cutoff = event["eoq"].get("change_id")
+                        if cutoff is not None:
+                            self.last_change_id = cutoff
+                    yield event
+                # server closed the stream cleanly → reconnect and resume
+            except (
+                aiohttp.ClientConnectionError,
+                aiohttp.ClientPayloadError,
+                asyncio.IncompleteReadError,
+            ):
+                pass
+            finally:
+                await self.close()
+            if (
+                self.max_reconnects is not None
+                and reconnects >= self.max_reconnects
+            ):
+                return
+            reconnects += 1
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, RECONNECT_BACKOFF_MAX)
+
+    async def changes(self) -> AsyncIterator[Dict[str, Any]]:
+        """Yield only change events as {type, rowid, cells, change_id}."""
+        async for event in self:
+            if "change" in event:
+                typ, rowid, cells, change_id = event["change"]
+                yield {
+                    "type": typ,
+                    "rowid": rowid,
+                    "cells": cells,
+                    "change_id": change_id,
+                }

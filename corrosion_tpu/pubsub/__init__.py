@@ -130,16 +130,30 @@ class SubsManager:
             matcher.last_seen = time.monotonic()
         return matcher
 
-    async def remove(self, sub_id: str) -> bool:
+    async def remove(self, sub_id: str, only_if_idle: bool = False) -> bool:
         async with self._lock:
-            matcher = self.by_id.pop(sub_id, None)
+            matcher = self.by_id.get(sub_id)
             if matcher is None:
                 return False
+            if only_if_idle and not self._is_reapable(matcher):
+                # an HTTP serve pinned/attached between the GC's scan and
+                # this call — the matcher is live again, keep it
+                return False
+            self.by_id.pop(sub_id, None)
             self.by_sql.pop(matcher.normalized, None)
         await matcher.stop()
         with contextlib.suppress(OSError):
             shutil.rmtree(matcher.sub_dir)
         return True
+
+    @staticmethod
+    def _is_reapable(m: Matcher) -> bool:
+        return m.failed is not None or (
+            not m.has_subscribers
+            and m.pins == 0
+            and m.ready.is_set()
+            and time.monotonic() - m.last_seen > GC_TIMEOUT
+        )
 
     # -- change routing ----------------------------------------------------
 
@@ -162,18 +176,11 @@ class SubsManager:
     async def _gc_loop(self) -> None:
         while True:
             await asyncio.sleep(GC_TICK)
-            now = time.monotonic()
             doomed = [
-                m.id
-                for m in self.by_id.values()
-                if m.failed is not None
-                or (
-                    not m.has_subscribers
-                    and m.pins == 0
-                    and m.ready.is_set()
-                    and now - m.last_seen > GC_TIMEOUT
-                )
+                m.id for m in self.by_id.values() if self._is_reapable(m)
             ]
             for sub_id in doomed:
-                logger.info("GC: removing idle subscription %s", sub_id)
-                await self.remove(sub_id)
+                # remove() re-checks reapability under the lock, so a serve
+                # that pinned the matcher since the scan wins
+                if await self.remove(sub_id, only_if_idle=True):
+                    logger.info("GC: removed idle subscription %s", sub_id)

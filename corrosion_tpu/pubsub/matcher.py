@@ -28,6 +28,7 @@ import contextlib
 import json
 import logging
 import sqlite3
+import threading
 import time
 from dataclasses import dataclass
 from pathlib import Path
@@ -45,6 +46,7 @@ CANDIDATE_BATCH_WINDOW = 0.6  # ref: 600 ms aggregation window
 PURGE_INTERVAL = 300.0  # ref: 5 min purge cadence
 CHANGES_RETENTION = 10_000  # newest change rows kept for catch-up
 SUBSCRIBER_QUEUE_SIZE = 1024
+MAX_SQL_VARS = 400  # per-query bound-variable budget (SQLite limit is 999+)
 
 
 def _cells_json(cells: Sequence[SqliteValue]) -> str:
@@ -58,12 +60,26 @@ class SubscriberLagged(Exception):
 @dataclass
 class Subscriber:
     queue: asyncio.Queue
+    closed: bool = False
 
     def push(self, event: dict) -> None:
         try:
             self.queue.put_nowait(event)
         except asyncio.QueueFull:
             raise SubscriberLagged()
+
+    def close(self, event: Optional[dict] = None) -> None:
+        """Deliver a ``__closed`` sentinel even when the queue is full, so
+        the HTTP stream loop always terminates after draining."""
+        self.closed = True
+        sentinel = event or {"eoq": None, "__closed": True}
+        while True:
+            try:
+                self.queue.put_nowait(sentinel)
+                return
+            except asyncio.QueueFull:
+                with contextlib.suppress(asyncio.QueueEmpty):
+                    self.queue.get_nowait()
 
 
 class Matcher:
@@ -109,6 +125,7 @@ class Matcher:
         self._cands: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
         self._conn: Optional[sqlite3.Connection] = None
+        self._db_lock = threading.Lock()  # serializes sub.sqlite writers vs close
         self._last_purge = time.monotonic()
 
     # -- setup -------------------------------------------------------------
@@ -240,12 +257,18 @@ class Matcher:
                 await self._task
             self._task = None
         for sub in self._subs:
-            with contextlib.suppress(asyncio.QueueFull):
-                sub.queue.put_nowait({"eoq": None, "__closed": True})
+            sub.close()
         self._subs.clear()
         if self._conn is not None:
-            self._conn.close()
-            self._conn = None
+            # a cancelled await of to_thread(_apply_diff) leaves the worker
+            # thread running; _db_lock makes close wait it out
+            conn, self._conn = self._conn, None
+
+            def _close():
+                with self._db_lock:
+                    conn.close()
+
+            await asyncio.to_thread(_close)
 
     @property
     def has_subscribers(self) -> bool:
@@ -308,6 +331,7 @@ class Matcher:
                 dead.append(sub)
         for sub in dead:
             logger.warning("subscription %s: dropping lagged subscriber", self.id)
+            sub.close()  # sentinel must land or the stream loop hangs forever
             self._subs.remove(sub)
 
     # -- snapshot reads (used by the HTTP layer for catch-up) --------------
@@ -431,37 +455,44 @@ class Matcher:
         self.columns = desc[self.n_pk_cols :]
 
         def _persist():
-            self._conn.execute("DELETE FROM columns")
-            self._conn.executemany(
-                "INSERT INTO columns (idx, name) VALUES (?, ?)",
-                list(enumerate(self.columns)),
-            )
-            rowid = 0
-            for row in rows:
-                rowid += 1
-                ident, pk_parts, cells = self._split_row(row)
-                self._conn.execute(
-                    "INSERT OR REPLACE INTO query (ident, rowid_out, cells"
-                    + "".join(f", pk_{i}" for i in range(len(pk_parts)))
-                    + ") VALUES (?, ?, ?"
-                    + ", ?" * len(pk_parts)
-                    + ")",
-                    (ident, rowid, _cells_json(cells), *pk_parts),
-                )
-            self._conn.execute(
-                "INSERT OR REPLACE INTO meta (key, value) VALUES "
-                "('max_rowid', ?)",
-                (rowid,),
-            )
-            self._conn.execute(
-                "INSERT OR REPLACE INTO meta (key, value) VALUES "
-                "('state', 'running')"
-            )
-            self._conn.commit()
+            with self._db_lock:
+                self._persist_locked(rows)
 
         await asyncio.to_thread(_persist)
         self.state = "running"
         self.ready.set()
+
+    def _persist_locked(self, rows) -> None:
+        conn = self._conn
+        if conn is None:
+            return
+        conn.execute("DELETE FROM columns")
+        conn.executemany(
+            "INSERT INTO columns (idx, name) VALUES (?, ?)",
+            list(enumerate(self.columns)),
+        )
+        rowid = 0
+        for row in rows:
+            rowid += 1
+            ident, pk_parts, cells = self._split_row(row)
+            conn.execute(
+                "INSERT OR REPLACE INTO query (ident, rowid_out, cells"
+                + "".join(f", pk_{i}" for i in range(len(pk_parts)))
+                + ") VALUES (?, ?, ?"
+                + ", ?" * len(pk_parts)
+                + ")",
+                (ident, rowid, _cells_json(cells), *pk_parts),
+            )
+        conn.execute(
+            "INSERT OR REPLACE INTO meta (key, value) VALUES "
+            "('max_rowid', ?)",
+            (rowid,),
+        )
+        conn.execute(
+            "INSERT OR REPLACE INTO meta (key, value) VALUES "
+            "('state', 'running')"
+        )
+        conn.commit()
 
     def _split_row(
         self, row: Sequence[SqliteValue]
@@ -498,10 +529,19 @@ class Matcher:
                     continue
                 pk_cols = self.pks[t_idx]
                 unpacked = [unpack_columns(p) for p in pks]
-                pred = sqlmod.restriction_predicate(ref, pk_cols, len(unpacked))
-                q = sqlmod.with_restriction(self.parsed, self.rewritten, pred)
-                params = tuple(v for row in unpacked for v in row)
-                queries.append((q, params))
+                # chunk so one query never exceeds SQLite's bound-variable
+                # limit, however large the ingest batch was
+                per_query = max(1, MAX_SQL_VARS // max(1, len(pk_cols)))
+                for start in range(0, len(unpacked), per_query):
+                    chunk = unpacked[start : start + per_query]
+                    pred = sqlmod.restriction_predicate(
+                        ref, pk_cols, len(chunk)
+                    )
+                    q = sqlmod.with_restriction(
+                        self.parsed, self.rewritten, pred
+                    )
+                    params = tuple(v for row in chunk for v in row)
+                    queries.append((q, params))
         if not queries:
             return
 
@@ -528,8 +568,19 @@ class Matcher:
         cands: Dict[str, Set[bytes]],
         full_rerun: bool,
     ) -> List[dict]:
-        conn = self._conn
-        assert conn is not None
+        with self._db_lock:
+            conn = self._conn
+            if conn is None:  # stopped mid-flight
+                return []
+            return self._apply_diff_locked(conn, results, cands, full_rerun)
+
+    def _apply_diff_locked(
+        self,
+        conn: sqlite3.Connection,
+        results: Dict[bytes, Tuple[List[bytes], List[SqliteValue]]],
+        cands: Dict[str, Set[bytes]],
+        full_rerun: bool,
+    ) -> List[dict]:
         events: List[dict] = []
         row = conn.execute(
             "SELECT value FROM meta WHERE key = 'max_rowid'"
@@ -556,14 +607,37 @@ class Matcher:
             )
 
         try:
+            # one scan loads every stored row this pass can touch: the whole
+            # table on a full re-run, else the candidate-PK rows per table
+            # (chunked under the bound-variable budget).  The dict serves
+            # both the upsert comparisons and the delete detection.
+            stored: Dict[bytes, Tuple[int, str]] = {}
+            if full_rerun:
+                for ident, rowid_out, cells in conn.execute(
+                    "SELECT ident, rowid_out, cells FROM query"
+                ):
+                    stored[ident] = (rowid_out, cells)
+            else:
+                for t_idx, ref in enumerate(self.parsed.tables):
+                    pks = cands.get(ref.name)
+                    if not pks:
+                        continue
+                    pk_list = list(pks)
+                    for start in range(0, len(pk_list), MAX_SQL_VARS):
+                        chunk = pk_list[start : start + MAX_SQL_VARS]
+                        marks = ",".join("?" for _ in chunk)
+                        for ident, rowid_out, cells in conn.execute(
+                            f"SELECT ident, rowid_out, cells FROM query "
+                            f"WHERE pk_{t_idx} IN ({marks})",
+                            tuple(chunk),
+                        ):
+                            stored[ident] = (rowid_out, cells)
+
             # upserts: result rows that are new or whose cells changed
             for ident, (pk_parts, cells) in results.items():
                 cj = _cells_json(cells)
-                stored = conn.execute(
-                    "SELECT rowid_out, cells FROM query WHERE ident = ?",
-                    (ident,),
-                ).fetchone()
-                if stored is None:
+                prev = stored.get(ident)
+                if prev is None:
                     max_rowid += 1
                     conn.execute(
                         "INSERT INTO query (ident, rowid_out, cells"
@@ -574,41 +648,18 @@ class Matcher:
                         (ident, max_rowid, cj, *pk_parts),
                     )
                     record("insert", max_rowid, cj)
-                elif stored[1] != cj:
+                elif prev[1] != cj:
                     conn.execute(
                         "UPDATE query SET cells = ? WHERE ident = ?", (cj, ident)
                     )
-                    record("update", stored[0], cj)
+                    record("update", prev[0], cj)
 
-            # deletes: stored rows hit by a candidate that vanished from the
-            # restricted result set
-            if full_rerun:
-                gone = conn.execute(
-                    "SELECT ident, rowid_out, cells FROM query"
-                ).fetchall()
-                for ident, rowid_out, cells in gone:
-                    if ident not in results:
-                        conn.execute(
-                            "DELETE FROM query WHERE ident = ?", (ident,)
-                        )
-                        record("delete", rowid_out, cells)
-            else:
-                for t_idx, ref in enumerate(self.parsed.tables):
-                    pks = cands.get(ref.name)
-                    if not pks:
-                        continue
-                    marks = ",".join("?" for _ in pks)
-                    rows = conn.execute(
-                        f"SELECT ident, rowid_out, cells FROM query "
-                        f"WHERE pk_{t_idx} IN ({marks})",
-                        tuple(pks),
-                    ).fetchall()
-                    for ident, rowid_out, cells in rows:
-                        if ident not in results:
-                            conn.execute(
-                                "DELETE FROM query WHERE ident = ?", (ident,)
-                            )
-                            record("delete", rowid_out, cells)
+            # deletes: stored rows the pass touched that vanished from the
+            # (restricted) result set
+            for ident, (rowid_out, cells) in stored.items():
+                if ident not in results:
+                    conn.execute("DELETE FROM query WHERE ident = ?", (ident,))
+                    record("delete", rowid_out, cells)
 
             conn.execute(
                 "INSERT OR REPLACE INTO meta (key, value) VALUES "
@@ -629,11 +680,13 @@ class Matcher:
     def _purge_changes(self) -> None:
         """Drop old change rows beyond the retention window (ref:
         pubsub.rs:1129)."""
-        conn = self._conn
-        assert conn is not None
-        conn.execute(
-            "DELETE FROM changes WHERE id <= "
-            "(SELECT MAX(id) FROM changes) - ?",
-            (CHANGES_RETENTION,),
-        )
-        conn.commit()
+        with self._db_lock:
+            conn = self._conn
+            if conn is None:
+                return
+            conn.execute(
+                "DELETE FROM changes WHERE id <= "
+                "(SELECT MAX(id) FROM changes) - ?",
+                (CHANGES_RETENTION,),
+            )
+            conn.commit()

@@ -1,0 +1,247 @@
+"""Client library tests (ref: crates/corro-client/ — execute / streaming
+query / schema / subscription resume with MissedChange detection,
+sub.rs:57-150)."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.agent import Agent, AgentConfig
+from corrosion_tpu.api.http import Api
+from corrosion_tpu.client import (
+    ClientError,
+    CorrosionApiClient,
+    CorrosionClient,
+    MissedChange,
+)
+from corrosion_tpu.pubsub import SubsManager
+from corrosion_tpu.pubsub import matcher as matcher_mod
+
+SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, "
+    'text TEXT NOT NULL DEFAULT "")'
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def fast_batching(monkeypatch):
+    monkeypatch.setattr(matcher_mod, "CANDIDATE_BATCH_WINDOW", 0.05)
+
+
+async def boot(tmp_path, db_path=":memory:"):
+    agent = Agent(AgentConfig(db_path=db_path, read_conns=2)).open_sync()
+    subs = SubsManager(str(tmp_path / "subs"), agent.pool)
+    subs.start()
+    api = Api(agent, subs=subs)
+    port = await api.start()
+    return agent, subs, api, f"http://127.0.0.1:{port}"
+
+
+async def shutdown(agent, subs, api):
+    await subs.stop()
+    await api.stop()
+    agent.close()
+
+
+def test_execute_query_schema_roundtrip(tmp_path):
+    async def main():
+        agent, subs, api, base = await boot(tmp_path)
+        async with CorrosionApiClient(base) as client:
+            await client.schema([SCHEMA])
+            res = await client.execute(
+                [
+                    ("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "one")),
+                    "INSERT INTO tests (id, text) VALUES (2, 'two')",
+                ]
+            )
+            assert res["results"][0]["rows_affected"] == 1
+            assert res["version"] == 1
+
+            cols, rows = await client.query_rows(
+                "SELECT id, text FROM tests ORDER BY id"
+            )
+            assert cols == ["id", "text"]
+            assert rows == [[1, "one"], [2, "two"]]
+
+            # parameterized query
+            _, rows = await client.query_rows(
+                "SELECT text FROM tests WHERE id = ?", (2,)
+            )
+            assert rows == [["two"]]
+
+            stats = await client.table_stats()
+            assert stats == {"tests": 2}
+
+            with pytest.raises(ClientError):
+                await client.query_rows("SELECT nope FROM missing")
+        await shutdown(agent, subs, api)
+
+    run(main())
+
+
+def test_schema_from_paths(tmp_path):
+    schema_file = tmp_path / "schema.sql"
+    schema_file.write_text(SCHEMA)
+
+    async def main():
+        agent, subs, api, base = await boot(tmp_path)
+        async with CorrosionApiClient(base) as client:
+            await client.schema_from_paths([str(schema_file)])
+            _, rows = await client.query_rows(
+                "SELECT name FROM sqlite_master WHERE name = 'tests'"
+            )
+            assert rows == [["tests"]]
+        await shutdown(agent, subs, api)
+
+    run(main())
+
+
+def test_local_read_pool(tmp_path):
+    db_path = str(tmp_path / "node.db")
+
+    async def main():
+        agent, subs, api, base = await boot(tmp_path, db_path=db_path)
+        async with CorrosionClient(base, db_path) as client:
+            await client.schema([SCHEMA])
+            await client.execute(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (7, "local"))]
+            )
+            conn = client.read_conn()
+            try:
+                assert conn.execute(
+                    "SELECT text FROM tests WHERE id = 7"
+                ).fetchone() == ("local",)
+                with pytest.raises(Exception):
+                    conn.execute("INSERT INTO tests (id) VALUES (9)")
+            finally:
+                conn.close()
+        await shutdown(agent, subs, api)
+
+    run(main())
+
+
+def test_subscription_stream_and_resume(tmp_path):
+    async def main():
+        agent, subs, api, base = await boot(tmp_path)
+        async with CorrosionApiClient(base) as client:
+            await client.schema([SCHEMA])
+            await client.execute(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "one"))]
+            )
+
+            stream = client.subscribe("SELECT id, text FROM tests")
+            events = stream.__aiter__()
+            # snapshot: columns, row, eoq
+            ev = await asyncio.wait_for(events.__anext__(), 5)
+            assert ev["columns"] == ["id", "text"]
+            ev = await asyncio.wait_for(events.__anext__(), 5)
+            assert ev["row"][1] == [1, "one"]
+            ev = await asyncio.wait_for(events.__anext__(), 5)
+            assert "eoq" in ev
+            assert stream.sub_id is not None
+
+            # live change arrives with a change id
+            await client.execute(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (2, "two"))]
+            )
+            ev = await asyncio.wait_for(events.__anext__(), 5)
+            typ, _rowid, cells, change_id = ev["change"]
+            assert typ == "insert"
+            assert cells == [2, "two"]
+            assert stream.last_change_id == change_id
+            sub_id, last_id = stream.sub_id, stream.last_change_id
+            await events.aclose()
+            await stream.close()
+
+            # resume from the recorded change id: only newer changes arrive
+            await client.execute(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (3, "three"))]
+            )
+            resumed = client.subscription(sub_id, from_id=last_id)
+            revents = resumed.__aiter__()
+            ev = await asyncio.wait_for(revents.__anext__(), 5)
+            assert ev["change"][2] == [3, "three"]
+            assert resumed.last_change_id == last_id + 1
+            await revents.aclose()
+            await resumed.close()
+        await shutdown(agent, subs, api)
+
+    run(main())
+
+
+def test_missed_change_detection(tmp_path):
+    """A change-id gap (history purged past the resume point) must raise
+    MissedChange (ref: sub.rs:139-150)."""
+
+    async def main():
+        agent, subs, api, base = await boot(tmp_path)
+        async with CorrosionApiClient(base) as client:
+            await client.schema([SCHEMA])
+            stream = client.subscribe("SELECT id, text FROM tests", skip_rows=True)
+            events = stream.__aiter__()
+            ev = await asyncio.wait_for(events.__anext__(), 5)
+            assert ev["columns"] == ["id", "text"]
+            ev = await asyncio.wait_for(events.__anext__(), 5)
+            assert "eoq" in ev
+            # pretend we last saw a change id far in the past
+            stream.last_change_id = -5
+            await client.execute(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "gap"))]
+            )
+            with pytest.raises(MissedChange):
+                while True:
+                    await asyncio.wait_for(events.__anext__(), 5)
+        await shutdown(agent, subs, api)
+
+    run(main())
+
+
+def test_reconnect_resumes_after_server_restart(tmp_path):
+    """The stream reconnects with from=last_change_id after the server
+    drops it (ref: sub.rs auto-reconnect)."""
+    db_path = str(tmp_path / "node.db")
+
+    async def main():
+        agent, subs, api, base = await boot(tmp_path, db_path=db_path)
+        async with CorrosionApiClient(base) as client:
+            await client.schema([SCHEMA])
+            stream = client.subscribe(
+                "SELECT id, text FROM tests", skip_rows=True
+            )
+            events = stream.__aiter__()
+            ev = await asyncio.wait_for(events.__anext__(), 5)
+            assert ev["columns"] == ["id", "text"]
+            ev = await asyncio.wait_for(events.__anext__(), 5)
+            assert "eoq" in ev
+
+            await client.execute(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "a"))]
+            )
+            ev = await asyncio.wait_for(events.__anext__(), 5)
+            assert ev["change"][2] == [1, "a"]
+
+            # drop every live listener: the client must reconnect to the
+            # same port and resume from its last change id
+            port = api.port
+            await api.stop()
+            api2 = Api(agent, subs=subs)
+            for attempt in range(20):
+                try:
+                    await api2.start(port=port)
+                    break
+                except OSError:
+                    await asyncio.sleep(0.1)
+            await client.execute(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (2, "b"))]
+            )
+            ev = await asyncio.wait_for(events.__anext__(), 10)
+            assert ev["change"][2] == [2, "b"]
+            await events.aclose()
+            await stream.close()
+            await shutdown(agent, subs, api2)
+
+    run(main())

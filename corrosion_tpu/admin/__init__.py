@@ -1,0 +1,307 @@
+"""Admin interface: UDS server + client.
+
+Equivalent of crates/corro-admin/ — a Unix-domain-socket server speaking
+JSON-framed (NDJSON here, vs the reference's length-delimited JSON)
+``Command``/``Response`` pairs (lib.rs:90-158):
+
+- ``ping``                      → pong with the node's HLC timestamp
+- ``sync-generate``             → dump the node's ``SyncStateV1``
+- ``locks --top N``             → longest-held in-flight booked locks
+  (the LockRegistry contention/deadlock debugger, agent.rs:787-962)
+- ``cluster members``           → persisted + live member table
+- ``cluster membership-states`` → raw SWIM member entries
+- ``cluster rejoin``            → renew identity + re-announce
+  (actor.rs:199-210 renew semantics)
+- ``cluster set-id``            → change the cluster id at runtime
+  (lib.rs:345-389)
+- ``actor version``             → this actor's version heads
+- ``compact-empties``           → collapse fully-overwritten versions into
+  cleared bookkeeping ranges (clear_overwritten_versions, util.rs:153-348)
+
+Response frames mirror the reference's ``Response`` enum: ``{"log": ...}``,
+``{"error": ...}``, ``{"json": ...}``, ``{"success": true}``.  Every
+command's frame stream is terminated by a success or error frame.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import os
+import time
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["AdminServer", "AdminClient", "AdminError"]
+
+
+class AdminError(Exception):
+    """Server-reported command failure."""
+
+
+class AdminServer:
+    """UDS admin server bound to one Node (ref: corro-admin start_server)."""
+
+    def __init__(self, node, uds_path: str) -> None:
+        self.node = node
+        self.uds_path = uds_path
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: set = set()
+
+    async def start(self) -> "AdminServer":
+        with contextlib.suppress(FileNotFoundError):
+            os.unlink(self.uds_path)
+        self._server = await asyncio.start_unix_server(
+            self._handle, path=self.uds_path
+        )
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # 3.12+ wait_closed() waits for handlers; idle clients block in
+            # readline() forever unless their connections are closed first
+            for w in list(self._writers):
+                w.close()
+            await self._server.wait_closed()
+            self._server = None
+        with contextlib.suppress(FileNotFoundError):
+            os.unlink(self.uds_path)
+
+    # -- connection loop ---------------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        async def send(frame: Dict[str, Any]) -> None:
+            writer.write(json.dumps(frame).encode() + b"\n")
+            await writer.drain()
+
+        self._writers.add(writer)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    cmd = json.loads(line)
+                except ValueError:
+                    await send({"error": "malformed command frame"})
+                    continue
+                try:
+                    await self._dispatch(cmd, send)
+                except Exception as e:
+                    logger.exception("admin command failed: %r", cmd)
+                    await send({"error": str(e)})
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
+
+    # -- commands ----------------------------------------------------------
+
+    async def _dispatch(self, cmd: Dict[str, Any], send) -> None:
+        node = self.node
+        name = cmd.get("cmd")
+        if name == "ping":
+            await send({"json": {"pong": node.agent.clock.new_timestamp()}})
+        elif name == "sync-generate":
+            state = node.agent.generate_sync()
+            await send({"json": _sync_state_obj(state)})
+        elif name == "locks":
+            top = int(cmd.get("top", 10))
+            now = time.monotonic()
+            await send(
+                {
+                    "json": [
+                        {
+                            "label": e.label,
+                            "kind": e.kind,
+                            "state": e.state,
+                            "duration": now - e.started_at,
+                        }
+                        for e in node.agent.registry.top(top)
+                    ]
+                }
+            )
+        elif name == "cluster-members":
+            rows = await node.agent.pool.read_call(
+                lambda c: c.execute(
+                    "SELECT actor_id, address, foca_state, rtt_min, "
+                    "cluster_id FROM __corro_members"
+                ).fetchall()
+            )
+            await send(
+                {
+                    "json": [
+                        {
+                            "actor_id": bytes(r[0]).hex(),
+                            "address": r[1],
+                            "state": json.loads(r[2]) if r[2] else None,
+                            "rtt_min": r[3],
+                            "cluster_id": r[4],
+                        }
+                        for r in rows
+                    ]
+                }
+            )
+        elif name == "cluster-membership-states":
+            # raw SWIM entries — alive/suspect/down + incarnations, the
+            # level of detail the Members registry deliberately hides
+            entries = node.swim.members if node.swim is not None else {}
+            await send(
+                {
+                    "json": [
+                        {
+                            "actor_id": actor_id.as_simple(),
+                            "addr": f"{e.actor.addr[0]}:{e.actor.addr[1]}",
+                            "state": e.state,
+                            "incarnation": e.incarnation,
+                            "state_since": e.state_since,
+                            "identity_ts": e.actor.ts,
+                        }
+                        for actor_id, e in entries.items()
+                    ]
+                }
+            )
+        elif name == "cluster-rejoin":
+            if node.swim is None:
+                raise AdminError("node has no gossip runtime")
+            node.swim.rejoin(node.agent.clock.new_timestamp())
+            await node._pump_swim()
+            await send({"log": "rejoined with renewed identity"})
+        elif name == "cluster-set-id":
+            new_id = int(cmd["cluster_id"])
+            node.config.gossip.cluster_id = new_id
+            if node.swim is not None:
+                identity = node.swim.identity
+                node.swim.identity = type(identity)(
+                    id=identity.id,
+                    addr=identity.addr,
+                    ts=node.agent.clock.new_timestamp(),
+                    cluster_id=new_id,
+                )
+            if node.broadcast is not None:
+                node.broadcast.cluster_id = new_id
+            if node.sync_server is not None:
+                node.sync_server.cluster_id = new_id
+            await send({"log": f"cluster id set to {new_id}"})
+        elif name == "actor-version":
+            book = node.agent.bookie.get(node.agent.actor_id)
+            last = book.versions.last() if book is not None else None
+            await send(
+                {
+                    "json": {
+                        "actor_id": node.agent.actor_id.as_simple(),
+                        "last_version": last,
+                    }
+                }
+            )
+        elif name == "compact-empties":
+            cleared = await node.agent.compact_empties()
+            await send(
+                {
+                    "json": {
+                        a.as_simple(): versions
+                        for a, versions in cleared.items()
+                    }
+                }
+            )
+        else:
+            await send({"error": f"unknown command: {name!r}"})
+            return
+        await send({"success": True})
+
+
+def _sync_state_obj(state) -> Dict[str, Any]:
+    return {
+        "actor_id": state.actor_id.as_simple(),
+        "heads": {a.as_simple(): v for a, v in state.heads.items()},
+        "need": {
+            a.as_simple(): [list(r) for r in ranges]
+            for a, ranges in state.need.items()
+        },
+        "partial_need": {
+            a.as_simple(): {
+                str(v): [list(r) for r in gaps] for v, gaps in partials.items()
+            }
+            for a, partials in state.partial_need.items()
+        },
+    }
+
+
+class AdminClient:
+    """UDS admin client (ref: the CLI's AdminConn)."""
+
+    def __init__(self, uds_path: str) -> None:
+        self.uds_path = uds_path
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending = False  # a previous response wasn't fully drained
+
+    async def __aenter__(self) -> "AdminClient":
+        self._reader, self._writer = await asyncio.open_unix_connection(
+            self.uds_path
+        )
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            with contextlib.suppress(Exception):
+                await self._writer.wait_closed()
+            self._reader = self._writer = None
+
+    async def frames(self, cmd: Dict[str, Any]) -> AsyncIterator[Dict[str, Any]]:
+        """Send one command, yield response frames until success/error.
+
+        A generator abandoned mid-response (``break``) leaves its terminal
+        frame unread; the next command drains it first, so responses never
+        go off-by-one.  (Draining in a ``finally`` wouldn't work: an
+        abandoned async generator's cleanup runs later, in the event
+        loop's GC task, not at the ``break``.)"""
+        assert self._writer is not None and self._reader is not None
+        while self._pending:
+            frame = await self._read_frame()
+            self._pending = not (frame.get("success") or "error" in frame)
+        self._writer.write(json.dumps(cmd).encode() + b"\n")
+        await self._writer.drain()
+        self._pending = True
+        while True:
+            frame = await self._read_frame()
+            done = frame.get("success") or "error" in frame
+            self._pending = not done
+            yield frame
+            if done:
+                return
+
+    async def _read_frame(self) -> Dict[str, Any]:
+        line = await self._reader.readline()
+        if not line:
+            raise AdminError("connection closed mid-response")
+        return json.loads(line)
+
+    async def call(self, cmd: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Send one command; return all frames; raise on error frames."""
+        out = []
+        async for frame in self.frames(cmd):
+            if "error" in frame:
+                raise AdminError(frame["error"])
+            out.append(frame)
+        return out
+
+    async def json(self, cmd: Dict[str, Any]) -> Any:
+        """Send one command and return its first json payload."""
+        for frame in await self.call(cmd):
+            if "json" in frame:
+                return frame["json"]
+        return None

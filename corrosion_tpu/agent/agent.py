@@ -151,6 +151,31 @@ class Agent:
                 )
         return result
 
+    async def compact_empties(self) -> Dict[ActorId, List[int]]:
+        """Collapse fully-overwritten versions into cleared bookkeeping
+        ranges (ref: clear_overwritten_versions, util.rs:153-348), updating
+        the in-memory ledgers to match."""
+
+        def _tx(conn: sqlite3.Connection):
+            conn.execute("BEGIN IMMEDIATE")
+            try:
+                out = apply_mod.compact_empties_tx(conn)
+                conn.execute("COMMIT")
+                return out
+            except BaseException:
+                conn.execute("ROLLBACK")
+                raise
+
+        result = await self.pool.write_call(_tx)
+        for actor, versions in result.items():
+            booked = self.bookie.ensure(actor)
+            async with booked.write(
+                f"compact_empties:{actor.as_simple()}"
+            ):
+                for v in versions:
+                    booked.versions.insert_many((v, v), Cleared())
+        return result
+
     # -- sync state --------------------------------------------------------
 
     def generate_sync(self) -> SyncStateV1:

@@ -79,6 +79,51 @@ def store_empty_changeset(
     )
 
 
+def find_cleared_db_versions(conn: sqlite3.Connection) -> List[int]:
+    """Local db versions whose clock rows have all been overwritten by newer
+    writes to the same (table, pk, cid) keys — they no longer appear in
+    ``crsql_changes`` at all, since clock rows upsert per key (ref:
+    find_cleared_db_versions, util.rs:546-594)."""
+    return [
+        r[0]
+        for r in conn.execute(
+            "SELECT DISTINCT db_version FROM __corro_bookkeeping "
+            "WHERE db_version IS NOT NULL "
+            "EXCEPT SELECT DISTINCT db_version FROM crsql_changes "
+            "ORDER BY db_version"
+        ).fetchall()
+    ]
+
+
+def compact_empties_tx(conn: sqlite3.Connection) -> Dict[ActorId, List[int]]:
+    """Collapse bookkeeping rows whose db version is fully overwritten into
+    cleared ranges (ref: clear_overwritten_versions, util.rs:153-348).
+    Returns {actor: [versions cleared]} so in-memory ledgers can be updated."""
+    cleared_dvs = set(find_cleared_db_versions(conn))
+    if not cleared_dvs:
+        return {}
+    out: Dict[ActorId, List[int]] = {}
+    rows = conn.execute(
+        "SELECT actor_id, start_version, db_version FROM __corro_bookkeeping "
+        "WHERE db_version IS NOT NULL ORDER BY actor_id, start_version"
+    ).fetchall()
+    for actor_blob, version, dv in rows:
+        if dv in cleared_dvs:
+            out.setdefault(ActorId(bytes(actor_blob)), []).append(version)
+    # one store_empty_changeset per contiguous run, not per version — a
+    # heavily-overwritten store can have 100k cleared versions in one range
+    for actor, versions in out.items():
+        start = prev = versions[0]
+        for v in versions[1:]:
+            if v == prev + 1:
+                prev = v
+                continue
+            store_empty_changeset(conn, actor, (start, prev))
+            start = prev = v
+        store_empty_changeset(conn, actor, (start, prev))
+    return out
+
+
 def clear_buffered_meta(
     conn: sqlite3.Connection, actor_id: ActorId, versions: Tuple[int, int]
 ) -> None:

@@ -59,6 +59,7 @@ class Node:
         self.sync_server: Optional[SyncServer] = None
         self.api: Optional[Api] = None
         self.subs: Optional[SubsManager] = None
+        self.admin = None  # AdminServer when config.admin.uds_path is set
         self._tasks: List[asyncio.Task] = []
         self._subs_tmpdir = None  # TemporaryDirectory for :memory: nodes
         self._started = False
@@ -136,6 +137,12 @@ class Node:
         )
         await self.api.start(api_host, api_port)
 
+        if self.config.admin.uds_path:
+            from ..admin import AdminServer
+
+            self.admin = AdminServer(self, self.config.admin.uds_path)
+            await self.admin.start()
+
         self.broadcast.start()
         self.ingest.start()
         self._tasks.append(asyncio.create_task(self._swim_loop()))
@@ -163,6 +170,9 @@ class Node:
             await self.broadcast.stop()
         if self.subs is not None:
             await self.subs.stop()
+        if self.admin is not None:
+            await self.admin.stop()
+            self.admin = None
         if self.api is not None:
             await self.api.stop()
         if self.transport is not None:

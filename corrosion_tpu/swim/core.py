@@ -151,6 +151,16 @@ class Swim:
         for m in self.up_members():
             self._emit(m.addr, msg)
 
+    def rejoin(self, ts: int) -> None:
+        """Renew the identity (bumped timestamp → peers treat us as a fresh
+        incarnation stream) and re-announce to every known member (ref:
+        Identity::renew actor.rs:199-210 + admin `cluster rejoin`)."""
+        self.identity = self.identity.renew(ts)
+        self._left = False
+        self.incarnation = 0
+        for m in self.up_members():
+            self._emit(m.addr, ("announce", actor_to_obj(self.identity)))
+
     # -- timers -----------------------------------------------------------
 
     def tick(self, now: float) -> None:

@@ -50,6 +50,7 @@ class AdminServer:
         self.uds_path = uds_path
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: set = set()
+        self._handlers: set = set()
 
     async def start(self) -> "AdminServer":
         with contextlib.suppress(FileNotFoundError):
@@ -62,10 +63,15 @@ class AdminServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            # 3.12+ wait_closed() waits for handlers; idle clients block in
-            # readline() forever unless their connections are closed first
+            # close client connections first: idle handlers block in
+            # readline() forever otherwise; then await the handler tasks
+            # ourselves (3.11's wait_closed() doesn't wait for them)
             for w in list(self._writers):
                 w.close()
+            if self._handlers:
+                await asyncio.gather(
+                    *self._handlers, return_exceptions=True
+                )
             await self._server.wait_closed()
             self._server = None
         with contextlib.suppress(FileNotFoundError):
@@ -81,6 +87,10 @@ class AdminServer:
             await writer.drain()
 
         self._writers.add(writer)
+        task = asyncio.current_task()
+        if task is not None:
+            self._handlers.add(task)
+            task.add_done_callback(self._handlers.discard)
         try:
             while True:
                 line = await reader.readline()
@@ -273,9 +283,11 @@ class AdminClient:
         while self._pending:
             frame = await self._read_frame()
             self._pending = not (frame.get("success") or "error" in frame)
+        # mark pending BEFORE the write: a cancellation inside drain() has
+        # already queued the command bytes, so a response is owed either way
+        self._pending = True
         self._writer.write(json.dumps(cmd).encode() + b"\n")
         await self._writer.drain()
-        self._pending = True
         while True:
             frame = await self._read_frame()
             done = frame.get("success") or "error" in frame

@@ -99,17 +99,21 @@ def compact_empties_tx(conn: sqlite3.Connection) -> Dict[ActorId, List[int]]:
     """Collapse bookkeeping rows whose db version is fully overwritten into
     cleared ranges (ref: clear_overwritten_versions, util.rs:153-348).
     Returns {actor: [versions cleared]} so in-memory ledgers can be updated."""
-    cleared_dvs = set(find_cleared_db_versions(conn))
-    if not cleared_dvs:
-        return {}
     out: Dict[ActorId, List[int]] = {}
+    # filter in SQL: only the newly-overwritten rows come back to Python,
+    # keeping write-lock hold time proportional to the work
     rows = conn.execute(
-        "SELECT actor_id, start_version, db_version FROM __corro_bookkeeping "
-        "WHERE db_version IS NOT NULL ORDER BY actor_id, start_version"
+        "SELECT actor_id, start_version FROM __corro_bookkeeping "
+        "WHERE db_version IS NOT NULL AND db_version IN ("
+        "  SELECT db_version FROM __corro_bookkeeping "
+        "  WHERE db_version IS NOT NULL "
+        "  EXCEPT SELECT DISTINCT db_version FROM crsql_changes"
+        ") ORDER BY actor_id, start_version"
     ).fetchall()
-    for actor_blob, version, dv in rows:
-        if dv in cleared_dvs:
-            out.setdefault(ActorId(bytes(actor_blob)), []).append(version)
+    for actor_blob, version in rows:
+        out.setdefault(ActorId(bytes(actor_blob)), []).append(version)
+    if not out:
+        return {}
     # one store_empty_changeset per contiguous run, not per version — a
     # heavily-overwritten store can have 100k cleared versions in one range
     for actor, versions in out.items():

@@ -1,0 +1,146 @@
+"""Backup and restore of a node's database.
+
+Equivalent of the ``corrosion backup`` / ``corrosion restore`` subcommands
+(crates/corrosion/src/main.rs:155-324):
+
+- ``backup``: ``VACUUM INTO`` a fresh snapshot, then make it site-neutral —
+  the node's own site id is moved off ordinal 0 to a fresh ordinal (clock
+  table rows rewritten to match), and per-node state (``__corro_members``,
+  consul hash tables) is stripped, so any node can adopt the snapshot.
+- ``restore_site_swap``: the inverse on a snapshot before it's swapped in —
+  the restoring node's site id is moved back to ordinal 0 (rewriting clock
+  rows from its previous ordinal) so the node keeps its identity.
+- ``restore``: site swap + subscription-state purge + online byte-level
+  copy under SQLite's locking protocol (utils/sqlite3_restore.py).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sqlite3
+from typing import List, Optional
+
+from .sqlite3_restore import Restored, restore as file_restore
+
+
+class BackupError(Exception):
+    pass
+
+
+def _clock_tables(conn: sqlite3.Connection) -> List[str]:
+    return [
+        r[0]
+        for r in conn.execute(
+            "SELECT name FROM sqlite_schema WHERE type = 'table' AND "
+            "name LIKE '%__crsql_clock'"
+        ).fetchall()
+    ]
+
+
+def backup(db_path: str, out_path: str) -> None:
+    """Snapshot ``db_path`` into ``out_path``, cleaned for restoration
+    (ref: main.rs:155-220)."""
+    parent = os.path.dirname(os.path.abspath(out_path))
+    os.makedirs(parent, exist_ok=True)
+    if os.path.exists(out_path):
+        raise BackupError(f"backup target already exists: {out_path}")
+
+    src = sqlite3.connect(db_path)
+    try:
+        src.execute("VACUUM INTO ?", (out_path,))
+    finally:
+        src.close()
+
+    conn = sqlite3.connect(out_path, isolation_level=None)
+    try:
+        row = conn.execute(
+            "DELETE FROM crsql_site_id WHERE ordinal = 0 RETURNING site_id"
+        ).fetchone()
+        if row is None:
+            raise BackupError("source database has no site id at ordinal 0")
+        site_id = bytes(row[0])
+        new_ordinal = conn.execute(
+            "INSERT INTO crsql_site_id (site_id) VALUES (?) RETURNING ordinal",
+            (site_id,),
+        ).fetchone()[0]
+        for table in _clock_tables(conn):
+            conn.execute(
+                f'UPDATE "{table}" SET site_id = ? WHERE site_id = 0',
+                (new_ordinal,),
+            )
+        # per-node state must not ride along into another node
+        conn.execute("DELETE FROM __corro_members")
+        for t in ("__corro_consul_services", "__corro_consul_checks"):
+            try:
+                conn.execute(f"DROP TABLE {t}")
+            except sqlite3.OperationalError:
+                pass  # never created on this node
+        conn.execute("PRAGMA journal_mode = WAL")  # restorable online
+        conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+    finally:
+        conn.close()
+
+
+def restore_site_swap(backup_path: str, site_id: bytes) -> Optional[int]:
+    """Give ``site_id`` ordinal 0 in the snapshot, rewriting clock rows
+    from its previous ordinal if the snapshot knew the actor (ref:
+    main.rs:241-292).  Returns the previous ordinal, if any."""
+    conn = sqlite3.connect(backup_path, isolation_level=None)
+    try:
+        row = conn.execute(
+            "DELETE FROM crsql_site_id WHERE site_id = ? RETURNING ordinal",
+            (site_id,),
+        ).fetchone()
+        ordinal = row[0] if row is not None else None
+        conn.execute(
+            "INSERT OR REPLACE INTO crsql_site_id (ordinal, site_id) "
+            "VALUES (0, ?)",
+            (site_id,),
+        )
+        if ordinal is not None and ordinal != 0:
+            for table in _clock_tables(conn):
+                conn.execute(
+                    f'UPDATE "{table}" SET site_id = 0 WHERE site_id = ?',
+                    (ordinal,),
+                )
+        return ordinal
+    finally:
+        conn.close()
+
+
+def restore(
+    backup_path: str,
+    db_path: str,
+    site_id: Optional[bytes] = None,
+    subscriptions_path: Optional[str] = None,
+    timeout: float = 30.0,
+) -> Restored:
+    """Full restore flow (ref: main.rs:221-324): optional site-id swap,
+    purge subscription state (it belongs to the pre-restore world), then
+    copy the snapshot over the (possibly live) database file under locks.
+
+    ``site_id`` defaults to the current database's own site id when the
+    target exists; pass it explicitly to restore under another identity."""
+    if site_id is None and os.path.exists(db_path):
+        conn = sqlite3.connect(db_path)
+        try:
+            row = conn.execute(
+                "SELECT site_id FROM crsql_site_id WHERE ordinal = 0"
+            ).fetchone()
+        except sqlite3.OperationalError:
+            row = None
+        finally:
+            conn.close()
+        if row is not None:
+            site_id = bytes(row[0])
+    if site_id is not None:
+        restore_site_swap(backup_path, site_id)
+
+    if subscriptions_path is not None:
+        shutil.rmtree(subscriptions_path, ignore_errors=True)
+
+    if os.path.abspath(backup_path) == os.path.abspath(db_path):
+        st = os.stat(db_path)
+        return Restored(old_len=st.st_size, new_len=st.st_size, is_wal=False)
+    return file_restore(backup_path, db_path, timeout)

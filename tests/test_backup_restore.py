@@ -1,0 +1,257 @@
+"""Backup/restore tests (ref: corrosion backup/restore, main.rs:155-324,
+and the lock-aware byte-level restore in crates/sqlite3-restore/)."""
+
+import asyncio
+import os
+import sqlite3
+
+import pytest
+
+from corrosion_tpu.agent import Agent, AgentConfig, make_broadcastable_changes
+from corrosion_tpu.types.schema import apply_schema
+from corrosion_tpu.utils import backup as backup_mod
+from corrosion_tpu.utils.sqlite3_restore import restore as file_restore
+
+SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, "
+    'text TEXT NOT NULL DEFAULT "") WITHOUT ROWID'
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_agent(db_path: str) -> Agent:
+    agent = Agent(AgentConfig(db_path=db_path, read_conns=1)).open_sync()
+    await agent.pool.write_call(lambda c: apply_schema(c, SCHEMA))
+    return agent
+
+
+async def write(agent: Agent, sql: str, params=()):
+    return await make_broadcastable_changes(agent, [(sql, params)])
+
+
+def test_backup_is_site_neutral(tmp_path):
+    db = str(tmp_path / "node.db")
+    out = str(tmp_path / "backup.db")
+
+    async def main():
+        agent = await make_agent(db)
+        await write(
+            agent, "INSERT INTO tests (id, text) VALUES (?, ?)", (1, "one")
+        )
+        # persisted member state that must not survive into the snapshot
+        await agent.pool.write_call(
+            lambda c: c.execute(
+                "INSERT INTO __corro_members (actor_id, address, foca_state, "
+                "rtt_min, cluster_id) VALUES (x'00', '1.2.3.4:1', '{}', 1, 0)"
+            )
+        )
+        site_id = bytes(agent.actor_id)
+        agent.close()
+
+        backup_mod.backup(db, out)
+
+        conn = sqlite3.connect(out)
+        try:
+            # ordinal 0 is vacant; our site id lives at a fresh ordinal
+            assert conn.execute(
+                "SELECT COUNT(*) FROM crsql_site_id WHERE ordinal = 0"
+            ).fetchone() == (0,)
+            (ordinal,) = conn.execute(
+                "SELECT ordinal FROM crsql_site_id WHERE site_id = ?",
+                (site_id,),
+            ).fetchone()
+            assert ordinal > 0
+            # clock rows follow the rewrite
+            rows = conn.execute(
+                "SELECT DISTINCT site_id FROM tests__crsql_clock"
+            ).fetchall()
+            assert rows == [(ordinal,)]
+            # per-node state stripped; data intact
+            assert conn.execute(
+                "SELECT COUNT(*) FROM __corro_members"
+            ).fetchone() == (0,)
+            assert conn.execute("SELECT id, text FROM tests").fetchall() == [
+                (1, "one")
+            ]
+        finally:
+            conn.close()
+
+    run(main())
+
+
+def test_backup_restore_roundtrip_new_identity(tmp_path):
+    """A different node adopts the snapshot: it keeps its own identity,
+    sees the source's rows attributed to the source actor, and its new
+    writes attribute to itself."""
+    db_a = str(tmp_path / "a.db")
+    db_b = str(tmp_path / "b.db")
+    out = str(tmp_path / "backup.db")
+
+    async def main():
+        a = await make_agent(db_a)
+        await write(a, "INSERT INTO tests (id, text) VALUES (?, ?)", (1, "from-a"))
+        site_a = bytes(a.actor_id)
+        a.close()
+        backup_mod.backup(db_a, out)
+
+        # node B exists already with its own identity and no data
+        b = await make_agent(db_b)
+        site_b = bytes(b.actor_id)
+        assert site_b != site_a
+        b.close()
+
+        backup_mod.restore(out, db_b)
+
+        b = Agent(AgentConfig(db_path=db_b, read_conns=1)).open_sync()
+        try:
+            assert bytes(b.actor_id) == site_b  # identity preserved
+            rows = await b.pool.read_call(
+                lambda c: c.execute("SELECT id, text FROM tests").fetchall()
+            )
+            assert rows == [(1, "from-a")]
+            # A's changes still attributed to A in the changes vtab
+            changes = await b.pool.read_call(
+                lambda c: c.execute(
+                    "SELECT DISTINCT site_id FROM crsql_changes"
+                ).fetchall()
+            )
+            assert [bytes(r[0]) for r in changes] == [site_a]
+
+            # new local writes attribute to B
+            await write(
+                b, "INSERT INTO tests (id, text) VALUES (?, ?)", (2, "from-b")
+            )
+            changes = await b.pool.read_call(
+                lambda c: c.execute(
+                    "SELECT DISTINCT site_id FROM crsql_changes "
+                    "WHERE db_version = (SELECT MAX(db_version) FROM "
+                    "crsql_changes)"
+                ).fetchall()
+            )
+            assert [bytes(r[0]) for r in changes] == [site_b]
+        finally:
+            b.close()
+
+    run(main())
+
+
+def test_restore_back_onto_source_keeps_ordinal_zero(tmp_path):
+    """Restoring a snapshot onto the node that produced it swaps its site
+    id back to ordinal 0 and rewrites clock rows (ref: main.rs:241-292)."""
+    db = str(tmp_path / "node.db")
+    out = str(tmp_path / "backup.db")
+
+    async def main():
+        agent = await make_agent(db)
+        await write(
+            agent, "INSERT INTO tests (id, text) VALUES (?, ?)", (1, "x")
+        )
+        site_id = bytes(agent.actor_id)
+        agent.close()
+
+        backup_mod.backup(db, out)
+        backup_mod.restore(out, db)
+
+        conn = sqlite3.connect(db)
+        try:
+            assert conn.execute(
+                "SELECT site_id FROM crsql_site_id WHERE ordinal = 0"
+            ).fetchone() == (site_id,)
+            assert conn.execute(
+                "SELECT DISTINCT site_id FROM tests__crsql_clock"
+            ).fetchall() == [(0,)]
+        finally:
+            conn.close()
+
+        # the agent reopens with the same identity and bookkeeping
+        agent = Agent(AgentConfig(db_path=db, read_conns=1)).open_sync()
+        try:
+            assert bytes(agent.actor_id) == site_id
+            assert agent.generate_sync().heads[agent.actor_id] == 1
+        finally:
+            agent.close()
+
+    run(main())
+
+
+def test_file_restore_non_wal(tmp_path):
+    src = str(tmp_path / "src.db")
+    dst = str(tmp_path / "dst.db")
+    for path, val in ((dst, 1), (src, 2)):
+        conn = sqlite3.connect(path)
+        conn.execute("CREATE TABLE foo (a INTEGER PRIMARY KEY, b INTEGER)")
+        conn.execute("INSERT INTO foo VALUES (1, ?)", (val,))
+        conn.commit()
+        conn.close()
+
+    restored = file_restore(src, dst, timeout=2.0)
+    assert not restored.is_wal
+    conn = sqlite3.connect(dst)
+    assert conn.execute("SELECT a, b FROM foo").fetchall() == [(1, 2)]
+    conn.close()
+
+
+def test_file_restore_wal_with_live_reader(tmp_path):
+    """Restore over a WAL database while another connection stays open;
+    the reader sees the new contents afterwards (shm zeroed → recovery)."""
+    src = str(tmp_path / "src.db")
+    dst = str(tmp_path / "dst.db")
+    for path, val in ((dst, 1), (src, 2)):
+        conn = sqlite3.connect(path)
+        conn.execute("PRAGMA journal_mode = WAL")
+        conn.execute("CREATE TABLE foo (a INTEGER PRIMARY KEY, b INTEGER)")
+        conn.execute("INSERT INTO foo VALUES (1, ?)", (val,))
+        conn.commit()
+        conn.close()
+
+    live = sqlite3.connect(dst)
+    assert live.execute("SELECT b FROM foo").fetchall() == [(1,)]
+
+    restored = file_restore(src, dst, timeout=2.0)
+    assert restored.is_wal
+    assert restored.old_len > 0
+
+    assert live.execute("SELECT b FROM foo").fetchall() == [(2,)]
+    live.close()
+
+
+def test_file_restore_times_out_on_held_lock(tmp_path):
+    """A writer in ANOTHER process holding the database locked makes
+    restore fail fast with LockTimedOut instead of corrupting the file.
+    (POSIX record locks never conflict within one process, so the holder
+    must be a subprocess.)"""
+    import subprocess
+    import sys
+
+    from corrosion_tpu.utils.sqlite3_restore import LockTimedOut
+
+    src = str(tmp_path / "src.db")
+    dst = str(tmp_path / "dst.db")
+    for path in (src, dst):
+        conn = sqlite3.connect(path)
+        conn.execute("CREATE TABLE foo (a INTEGER PRIMARY KEY)")
+        conn.commit()
+        conn.close()
+
+    holder = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import sqlite3, sys, time\n"
+            f"conn = sqlite3.connect({dst!r}, isolation_level=None)\n"
+            "conn.execute('BEGIN EXCLUSIVE')\n"
+            "print('locked', flush=True)\n"
+            "time.sleep(30)\n",
+        ],
+        stdout=subprocess.PIPE,
+    )
+    try:
+        assert holder.stdout.readline().strip() == b"locked"
+        with pytest.raises(LockTimedOut):
+            file_restore(src, dst, timeout=0.3)
+    finally:
+        holder.kill()
+        holder.wait()

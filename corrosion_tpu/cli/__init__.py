@@ -1,0 +1,474 @@
+"""The ``corrosion`` command-line interface.
+
+Equivalent of crates/corrosion/ (subcommand table at
+corrosion/src/main.rs:578-653):
+
+- ``agent``                 — run the node daemon (command/agent.rs:15-103)
+- ``backup <path>``         — site-neutral snapshot (main.rs:155-220)
+- ``restore <path>``        — offline/online restore w/ site-id swap
+  (main.rs:221-324; refuses while an agent is running)
+- ``cluster rejoin|members|membership-states|set-id`` — via the admin UDS
+- ``query`` / ``exec``      — through the HTTP API client
+- ``reload``                — re-apply schema paths (command/reload.rs)
+- ``sync generate``         — dump SyncStateV1 (admin)
+- ``locks [--top N]``       — LockRegistry dump (admin)
+- ``actor version``         — actor heads (admin)
+- ``compact-empties``       — bookkeeping compaction (admin)
+- ``template src:dst[:cmd]`` — render/watch templates (command/tpl.rs)
+- ``consul sync``           — Consul → corrosion sync loop
+- ``tls ca|server|client generate`` — cert generation (command/tls.rs)
+
+Run as ``python -m corrosion_tpu.cli`` (or the ``corrosion-tpu`` console
+script).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import json
+import signal
+import sys
+import time
+from typing import Any, List, Optional
+
+from ..types.config import Config
+
+
+def _die(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"error: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def load_config(args) -> Config:
+    try:
+        return Config.load(args.config)
+    except FileNotFoundError:
+        _die(f"config file not found: {args.config}")
+
+
+def api_base(config: Config) -> str:
+    from ..types.config import parse_addr
+
+    host, port = parse_addr(config.api.addr)
+    return f"http://{host}:{port}"
+
+
+# -- subcommand implementations ---------------------------------------------
+
+
+async def cmd_agent(args) -> int:
+    from ..agent.node import Node
+
+    config = load_config(args)
+    node = await Node(config).start()
+    api_addr = f"127.0.0.1:{node.api.port}"
+    print(f"agent running: api={api_addr} gossip={node.gossip_addr}")
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("shutting down…")
+    await node.stop()
+    return 0
+
+
+async def cmd_backup(args) -> int:
+    from ..utils.backup import backup
+
+    config = load_config(args)
+    backup(config.db.path, args.path)
+    print(f"backed up database to {args.path}")
+    return 0
+
+
+async def cmd_restore(args) -> int:
+    from ..utils.backup import restore
+
+    config = load_config(args)
+    if config.admin.uds_path:
+        # an agent answering on the admin socket means it's running
+        # (ref: main.rs:228-230 bails if AdminConn connects)
+        from ..admin import AdminClient
+
+        try:
+            async with AdminClient(config.admin.uds_path) as admin:
+                await admin.json({"cmd": "ping"})
+        except (OSError, ConnectionError):
+            pass
+        else:
+            _die("corrosion is currently running, shut it down before restoring!")
+    site_id = None
+    if args.actor_id:
+        from ..types.actor import ActorId
+
+        try:
+            site_id = bytes(ActorId(args.actor_id))
+        except ValueError:
+            _die(f"invalid actor id: {args.actor_id!r}")
+    restored = restore(
+        args.path,
+        config.db.path,
+        site_id=site_id,
+        subscriptions_path=config.db.resolved_subscriptions_path(),
+    )
+    print(
+        f"successfully restored! old size: {restored.old_len}, "
+        f"new size: {restored.new_len}"
+    )
+    return 0
+
+
+async def _admin_json(args, cmd: dict) -> Any:
+    from ..admin import AdminClient
+
+    config = load_config(args)
+    if not config.admin.uds_path:
+        _die("no admin.uds_path configured")
+    async with AdminClient(config.admin.uds_path) as admin:
+        frames = await admin.call(cmd)
+    for frame in frames:
+        if "log" in frame:
+            print(frame["log"])
+        if "json" in frame:
+            print(json.dumps(frame["json"], indent=2))
+    return 0
+
+
+async def cmd_cluster(args) -> int:
+    sub = args.cluster_cmd
+    if sub == "rejoin":
+        return await _admin_json(args, {"cmd": "cluster-rejoin"})
+    if sub == "members":
+        return await _admin_json(args, {"cmd": "cluster-members"})
+    if sub == "membership-states":
+        return await _admin_json(args, {"cmd": "cluster-membership-states"})
+    if sub == "set-id":
+        return await _admin_json(
+            args, {"cmd": "cluster-set-id", "cluster_id": args.id}
+        )
+    _die(f"unknown cluster subcommand {sub!r}")
+
+
+async def cmd_query(args) -> int:
+    from ..client import ClientError, CorrosionApiClient
+
+    config = load_config(args)
+    async with CorrosionApiClient(
+        api_base(config), token=config.api.authz_bearer
+    ) as client:
+        start = time.monotonic()
+        try:
+            stream = await client.query(args.sql, args.param or None)
+            async for event in stream:
+                if "columns" in event and args.columns:
+                    print("\t".join(event["columns"]))
+                elif "row" in event:
+                    print(
+                        "\t".join(
+                            _cell_str(c) for c in event["row"][1]
+                        )
+                    )
+                elif "error" in event:
+                    _die(event["error"])
+        except ClientError as e:
+            _die(str(e))
+        if args.timer:
+            print(f"time: {time.monotonic() - start:.3f}s", file=sys.stderr)
+    return 0
+
+
+async def cmd_exec(args) -> int:
+    from ..client import ClientError, CorrosionApiClient
+
+    config = load_config(args)
+    async with CorrosionApiClient(
+        api_base(config), token=config.api.authz_bearer
+    ) as client:
+        try:
+            res = await client.execute(
+                [(args.sql, tuple(args.param or ()))]
+            )
+        except ClientError as e:
+            _die(str(e))
+    for r in res.get("results", []):
+        print(f"rows affected: {r.get('rows_affected')}")
+    if args.timer:
+        print(f"time: {res.get('time', 0):.3f}s", file=sys.stderr)
+    return 0
+
+
+async def cmd_reload(args) -> int:
+    from ..client import ClientError, CorrosionApiClient
+
+    config = load_config(args)
+    if not config.db.schema_paths:
+        _die("no db.schema_paths configured")
+    async with CorrosionApiClient(
+        api_base(config), token=config.api.authz_bearer
+    ) as client:
+        try:
+            await client.schema_from_paths(config.db.schema_paths)
+        except ClientError as e:
+            _die(str(e))
+    print(f"reloaded schema from {', '.join(config.db.schema_paths)}")
+    return 0
+
+
+async def cmd_sync(args) -> int:
+    if args.sync_cmd == "generate":
+        return await _admin_json(args, {"cmd": "sync-generate"})
+    _die(f"unknown sync subcommand {args.sync_cmd!r}")
+
+
+async def cmd_locks(args) -> int:
+    return await _admin_json(args, {"cmd": "locks", "top": args.top})
+
+
+async def cmd_actor(args) -> int:
+    if args.actor_cmd == "version":
+        return await _admin_json(args, {"cmd": "actor-version"})
+    _die(f"unknown actor subcommand {args.actor_cmd!r}")
+
+
+async def cmd_compact_empties(args) -> int:
+    return await _admin_json(args, {"cmd": "compact-empties"})
+
+
+async def cmd_template(args) -> int:
+    from ..client import CorrosionApiClient
+    from ..tpl import TemplateError
+    from ..tpl.watch import TemplateWatcher, parse_template_spec
+
+    config = load_config(args)
+    async with CorrosionApiClient(
+        api_base(config), token=config.api.authz_bearer
+    ) as client:
+        watchers = []
+        for spec in args.template:
+            src, dst, cmd = parse_template_spec(spec)
+            watchers.append(
+                TemplateWatcher(client, src, dst, cmd=cmd, once=args.once)
+            )
+        tasks = [asyncio.create_task(w.run()) for w in watchers]
+        try:
+            if args.once:
+                await asyncio.gather(*tasks)
+                return 0
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(sig, stop.set)
+            stop_task = asyncio.create_task(stop.wait())
+            # surface a watcher's startup failure (missing template, bad
+            # syntax, server down) immediately instead of hanging
+            done, _ = await asyncio.wait(
+                [*tasks, stop_task], return_when=asyncio.FIRST_COMPLETED
+            )
+            stop_task.cancel()
+            for t in done:
+                if t is not stop_task and t.exception() is not None:
+                    _die(str(t.exception()))
+        except (TemplateError, OSError) as e:
+            _die(str(e))
+        finally:
+            for t in tasks:
+                t.cancel()
+            for t in tasks:
+                with contextlib.suppress(asyncio.CancelledError, Exception):
+                    await t
+    return 0
+
+
+async def cmd_consul(args) -> int:
+    from ..client import CorrosionApiClient
+    from ..consul import ConsulClient, ConsulSync, ConsulSyncError
+
+    config = load_config(args)
+    if args.consul_cmd != "sync":
+        _die(f"unknown consul subcommand {args.consul_cmd!r}")
+    consul = ConsulClient(args.consul_addr)
+    try:
+        async with CorrosionApiClient(
+            api_base(config), token=config.api.authz_bearer
+        ) as corrosion:
+            sync = ConsulSync(consul, corrosion)
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(sig, stop.set)
+            task = asyncio.create_task(sync.run())
+            stop_task = asyncio.create_task(stop.wait())
+            await asyncio.wait(
+                [task, stop_task], return_when=asyncio.FIRST_COMPLETED
+            )
+            stop_task.cancel()
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            except ConsulSyncError as e:
+                _die(str(e))
+    finally:
+        await consul.close()
+    return 0
+
+
+async def cmd_tls(args) -> int:
+    from ..utils import tls as tlsmod
+
+    if args.tls_cmd == "ca":
+        cert, key = tlsmod.generate_ca()
+        tlsmod.write_pair(cert, key, args.cert, args.key)
+        print(f"wrote CA cert to {args.cert} and key to {args.key}")
+    elif args.tls_cmd == "server":
+        with open(args.ca_cert, "rb") as f:
+            ca_cert = f.read()
+        with open(args.ca_key, "rb") as f:
+            ca_key = f.read()
+        cert, key = tlsmod.generate_server_cert(ca_cert, ca_key, args.addr)
+        tlsmod.write_pair(cert, key, args.cert, args.key)
+        print(f"wrote server cert to {args.cert} and key to {args.key}")
+    elif args.tls_cmd == "client":
+        with open(args.ca_cert, "rb") as f:
+            ca_cert = f.read()
+        with open(args.ca_key, "rb") as f:
+            ca_key = f.read()
+        cert, key = tlsmod.generate_client_cert(ca_cert, ca_key)
+        tlsmod.write_pair(cert, key, args.cert, args.key)
+        print(f"wrote client cert to {args.cert} and key to {args.key}")
+    else:
+        _die(f"unknown tls subcommand {args.tls_cmd!r}")
+    return 0
+
+
+def _cell_str(cell: Any) -> str:
+    if cell is None:
+        return ""
+    if isinstance(cell, dict) and "blob" in cell:
+        return f"x'{cell['blob']}'"
+    return str(cell)
+
+
+# -- parser -----------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="corrosion-tpu",
+        description="Gossip-replicated SQLite for distributed systems "
+        "(TPU-native corrosion)",
+    )
+    p.add_argument(
+        "-c",
+        "--config",
+        default="config.toml",
+        help="path to the TOML config file",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("agent", help="run the node daemon").set_defaults(
+        fn=cmd_agent
+    )
+
+    sp = sub.add_parser("backup", help="snapshot the database")
+    sp.add_argument("path")
+    sp.set_defaults(fn=cmd_backup)
+
+    sp = sub.add_parser("restore", help="restore a snapshot")
+    sp.add_argument("path")
+    sp.add_argument(
+        "--actor-id",
+        help="restore under this site id (hex); default: keep the current "
+        "database's identity",
+    )
+    sp.set_defaults(fn=cmd_restore)
+
+    sp = sub.add_parser("cluster", help="cluster admin commands")
+    csub = sp.add_subparsers(dest="cluster_cmd", required=True)
+    csub.add_parser("rejoin")
+    csub.add_parser("members")
+    csub.add_parser("membership-states")
+    sid = csub.add_parser("set-id")
+    sid.add_argument("id", type=int)
+    sp.set_defaults(fn=cmd_cluster)
+
+    sp = sub.add_parser("query", help="run a read query over the HTTP API")
+    sp.add_argument("sql")
+    sp.add_argument("--columns", action="store_true", help="print a header")
+    sp.add_argument("--timer", action="store_true")
+    sp.add_argument("--param", action="append")
+    sp.set_defaults(fn=cmd_query)
+
+    sp = sub.add_parser("exec", help="run a write statement")
+    sp.add_argument("sql")
+    sp.add_argument("--param", action="append")
+    sp.add_argument("--timer", action="store_true")
+    sp.set_defaults(fn=cmd_exec)
+
+    sub.add_parser("reload", help="re-apply schema paths").set_defaults(
+        fn=cmd_reload
+    )
+
+    sp = sub.add_parser("sync", help="sync protocol tools")
+    ssub = sp.add_subparsers(dest="sync_cmd", required=True)
+    ssub.add_parser("generate")
+    sp.set_defaults(fn=cmd_sync)
+
+    sp = sub.add_parser("locks", help="dump in-flight booked locks")
+    sp.add_argument("--top", type=int, default=10)
+    sp.set_defaults(fn=cmd_locks)
+
+    sp = sub.add_parser("actor", help="actor info")
+    asub = sp.add_subparsers(dest="actor_cmd", required=True)
+    asub.add_parser("version")
+    sp.set_defaults(fn=cmd_actor)
+
+    sub.add_parser(
+        "compact-empties", help="collapse overwritten versions"
+    ).set_defaults(fn=cmd_compact_empties)
+
+    sp = sub.add_parser("template", help="render templates (watch mode)")
+    sp.add_argument("template", nargs="+", help="src:dst[:cmd] specs")
+    sp.add_argument("--once", action="store_true")
+    sp.set_defaults(fn=cmd_template)
+
+    sp = sub.add_parser("consul", help="consul integration")
+    nsub = sp.add_subparsers(dest="consul_cmd", required=True)
+    # on the sync subparser so `consul sync --consul-addr X` parses
+    nsub.add_parser("sync").add_argument(
+        "--consul-addr", default="http://127.0.0.1:8500"
+    )
+    sp.set_defaults(fn=cmd_consul)
+
+    sp = sub.add_parser("tls", help="certificate generation")
+    tsub = sp.add_subparsers(dest="tls_cmd", required=True)
+    ca = tsub.add_parser("ca")
+    ca.add_argument("--cert", default="ca_cert.pem")
+    ca.add_argument("--key", default="ca_key.pem")
+    server = tsub.add_parser("server")
+    server.add_argument("addr", nargs="+", help="IPs/DNS names for SANs")
+    server.add_argument("--ca-cert", default="ca_cert.pem")
+    server.add_argument("--ca-key", default="ca_key.pem")
+    server.add_argument("--cert", default="server_cert.pem")
+    server.add_argument("--key", default="server_key.pem")
+    client = tsub.add_parser("client")
+    client.add_argument("--ca-cert", default="ca_cert.pem")
+    client.add_argument("--ca-key", default="ca_key.pem")
+    client.add_argument("--cert", default="client_cert.pem")
+    client.add_argument("--key", default="client_key.pem")
+    sp.set_defaults(fn=cmd_tls)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return asyncio.run(args.fn(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,381 @@
+"""Consul → corrosion synchronization.
+
+Equivalent of crates/consul-client/ + crates/corrosion/src/command/consul/
+sync.rs: poll the local Consul agent's services and checks every second,
+hash each entry, and apply only the diffs — upserts and deletes of the
+CRDT ``consul_services`` / ``consul_checks`` tables plus the local
+``__corro_consul_services`` / ``__corro_consul_checks`` hash tables — in
+one corrosion transaction, so Consul state rides corrosion replication
+(sync.rs:20-120).
+
+Check hashing honors the reference's notes directive: a check whose
+``Notes`` field carries ``{"hash_include": ["status", "output"]}`` hashes
+those fields; otherwise only ``status`` (plus the service identity)
+contributes, so flapping ``output`` text doesn't cause write storms
+(sync.rs hash_check).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import socket
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import aiohttp
+
+logger = logging.getLogger(__name__)
+
+CONSUL_PULL_INTERVAL = 1.0  # ref: sync.rs:18
+
+SETUP_STATEMENTS = [
+    "CREATE TABLE IF NOT EXISTS __corro_consul_services ("
+    "id TEXT NOT NULL PRIMARY KEY, hash BLOB NOT NULL)",
+    "CREATE TABLE IF NOT EXISTS __corro_consul_checks ("
+    "id TEXT NOT NULL PRIMARY KEY, hash BLOB NOT NULL)",
+]
+
+# the replicated tables the operator's schema must provide (ref: setup()'s
+# expected_cols check in sync.rs)
+EXPECTED_SERVICE_COLS = {
+    "node", "id", "name", "tags", "meta", "port", "address", "updated_at",
+}
+EXPECTED_CHECK_COLS = {
+    "node", "id", "service_id", "service_name", "name", "status", "output",
+    "updated_at",
+}
+
+
+class ConsulSyncError(Exception):
+    pass
+
+
+@dataclass
+class AgentService:
+    id: str
+    name: str = ""
+    tags: List[str] = field(default_factory=list)
+    meta: Dict[str, str] = field(default_factory=dict)
+    port: int = 0
+    address: str = ""
+
+    @classmethod
+    def from_api(cls, obj: Dict[str, Any]) -> "AgentService":
+        return cls(
+            id=obj.get("ID", ""),
+            name=obj.get("Service", ""),
+            tags=obj.get("Tags") or [],
+            meta=obj.get("Meta") or {},
+            port=obj.get("Port") or 0,
+            address=obj.get("Address") or "",
+        )
+
+
+@dataclass
+class AgentCheck:
+    id: str
+    name: str = ""
+    status: str = ""
+    output: str = ""
+    service_id: str = ""
+    service_name: str = ""
+    notes: str = ""
+
+    @classmethod
+    def from_api(cls, obj: Dict[str, Any]) -> "AgentCheck":
+        return cls(
+            id=obj.get("CheckID", ""),
+            name=obj.get("Name", ""),
+            status=obj.get("Status", ""),
+            output=obj.get("Output", ""),
+            service_id=obj.get("ServiceID", ""),
+            service_name=obj.get("ServiceName", ""),
+            notes=obj.get("Notes", ""),
+        )
+
+
+class ConsulClient:
+    """Minimal Consul agent HTTP client (ref: crates/consul-client/)."""
+
+    def __init__(
+        self,
+        base_url: str = "http://127.0.0.1:8500",
+        session: Optional[aiohttp.ClientSession] = None,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self._session = session
+
+    @property
+    def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+    async def agent_services(self) -> Dict[str, AgentService]:
+        async with self.session.get(
+            f"{self.base_url}/v1/agent/services"
+        ) as resp:
+            resp.raise_for_status()
+            body = await resp.json()
+        return {k: AgentService.from_api(v) for k, v in body.items()}
+
+    async def agent_checks(self) -> Dict[str, AgentCheck]:
+        async with self.session.get(
+            f"{self.base_url}/v1/agent/checks"
+        ) as resp:
+            resp.raise_for_status()
+            body = await resp.json()
+        return {k: AgentCheck.from_api(v) for k, v in body.items()}
+
+
+# -- hashing ----------------------------------------------------------------
+
+
+def _hash64(parts: List[str]) -> bytes:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p.encode())
+        h.update(b"\x00")
+    return h.digest()[:8]
+
+
+def hash_service(svc: AgentService) -> bytes:
+    return _hash64(
+        [
+            svc.id,
+            svc.name,
+            json.dumps(sorted(svc.tags)),
+            json.dumps(svc.meta, sort_keys=True),
+            str(svc.port),
+            svc.address,
+        ]
+    )
+
+
+def hash_check(check: AgentCheck) -> bytes:
+    parts = [check.service_name, check.service_id]
+    directives = None
+    if check.notes:
+        try:
+            directives = json.loads(check.notes).get("hash_include")
+        except (ValueError, AttributeError):
+            directives = None
+    if directives:
+        for fld in directives:
+            if fld == "status":
+                parts.append(check.status)
+            elif fld == "output":
+                parts.append(check.output)
+    else:
+        parts.append(check.status)
+    return _hash64(parts)
+
+
+# -- sync engine ------------------------------------------------------------
+
+
+@dataclass
+class ApplyStats:
+    upserted: int = 0
+    deleted: int = 0
+
+    def is_zero(self) -> bool:
+        return self.upserted == 0 and self.deleted == 0
+
+
+class ConsulSync:
+    """The diff-and-apply engine (ref: update_consul in sync.rs)."""
+
+    def __init__(
+        self,
+        consul: ConsulClient,
+        corrosion,  # CorrosionApiClient
+        node: Optional[str] = None,
+    ) -> None:
+        self.consul = consul
+        self.corrosion = corrosion
+        self.node = node or socket.gethostname()
+        self.service_hashes: Dict[str, bytes] = {}
+        self.check_hashes: Dict[str, bytes] = {}
+
+    async def setup(self) -> None:
+        """Create hash tables and validate the replicated schema
+        (ref: setup in sync.rs)."""
+        await self.corrosion.execute(SETUP_STATEMENTS)
+        for table, expected in (
+            ("consul_services", EXPECTED_SERVICE_COLS),
+            ("consul_checks", EXPECTED_CHECK_COLS),
+        ):
+            _, rows = await self.corrosion.query_rows(
+                f"PRAGMA table_info({table})"
+            )
+            have = {r[1] for r in rows}
+            missing = expected - have
+            if missing:
+                raise ConsulSyncError(
+                    f"table {table} is missing columns {sorted(missing)}; "
+                    "add it to the corrosion schema"
+                )
+
+    async def load_hashes(self) -> None:
+        """Populate in-memory hashes from the local hash tables, so a
+        restart doesn't rewrite everything (ref: sync.rs:54-88)."""
+        _, rows = await self.corrosion.query_rows(
+            "SELECT id, hash FROM __corro_consul_services"
+        )
+        self.service_hashes = {r[0]: _as_bytes(r[1]) for r in rows}
+        _, rows = await self.corrosion.query_rows(
+            "SELECT id, hash FROM __corro_consul_checks"
+        )
+        self.check_hashes = {r[0]: _as_bytes(r[1]) for r in rows}
+
+    async def update(
+        self, updated_at: Optional[int] = None
+    ) -> Tuple[ApplyStats, ApplyStats]:
+        """One poll/diff/apply round (ref: update_consul)."""
+        import time
+
+        if updated_at is None:
+            updated_at = int(time.time())
+        services = await self.consul.agent_services()
+        checks = await self.consul.agent_checks()
+
+        statements: List[Any] = []
+        svc_stats = ApplyStats()
+        check_stats = ApplyStats()
+        new_svc_hashes: Dict[str, bytes] = {}
+        new_check_hashes: Dict[str, bytes] = {}
+
+        for svc in services.values():
+            h = hash_service(svc)
+            new_svc_hashes[svc.id] = h
+            if self.service_hashes.get(svc.id) == h:
+                continue
+            svc_stats.upserted += 1
+            statements.append(
+                (
+                    "INSERT INTO __corro_consul_services (id, hash) VALUES "
+                    "(?, ?) ON CONFLICT (id) DO UPDATE SET hash = "
+                    "excluded.hash",
+                    [svc.id, {"blob": h.hex()}],
+                )
+            )
+            statements.append(
+                (
+                    "INSERT INTO consul_services (node, id, name, tags, "
+                    "meta, port, address, updated_at) VALUES "
+                    "(?,?,?,?,?,?,?,?) ON CONFLICT (node, id) DO UPDATE SET "
+                    "name = excluded.name, tags = excluded.tags, meta = "
+                    "excluded.meta, port = excluded.port, address = "
+                    "excluded.address, updated_at = excluded.updated_at",
+                    [
+                        self.node,
+                        svc.id,
+                        svc.name,
+                        json.dumps(svc.tags),
+                        json.dumps(svc.meta),
+                        svc.port,
+                        svc.address,
+                        updated_at,
+                    ],
+                )
+            )
+        for gone in set(self.service_hashes) - set(new_svc_hashes):
+            svc_stats.deleted += 1
+            statements.append(
+                ("DELETE FROM __corro_consul_services WHERE id = ?", [gone])
+            )
+            statements.append(
+                (
+                    "DELETE FROM consul_services WHERE node = ? AND id = ?",
+                    [self.node, gone],
+                )
+            )
+
+        for check in checks.values():
+            h = hash_check(check)
+            new_check_hashes[check.id] = h
+            if self.check_hashes.get(check.id) == h:
+                continue
+            check_stats.upserted += 1
+            statements.append(
+                (
+                    "INSERT INTO __corro_consul_checks (id, hash) VALUES "
+                    "(?, ?) ON CONFLICT (id) DO UPDATE SET hash = "
+                    "excluded.hash",
+                    [check.id, {"blob": h.hex()}],
+                )
+            )
+            statements.append(
+                (
+                    "INSERT INTO consul_checks (node, id, service_id, "
+                    "service_name, name, status, output, updated_at) VALUES "
+                    "(?,?,?,?,?,?,?,?) ON CONFLICT (node, id) DO UPDATE SET "
+                    "service_id = excluded.service_id, service_name = "
+                    "excluded.service_name, name = excluded.name, status = "
+                    "excluded.status, output = excluded.output, updated_at "
+                    "= excluded.updated_at",
+                    [
+                        self.node,
+                        check.id,
+                        check.service_id,
+                        check.service_name,
+                        check.name,
+                        check.status,
+                        check.output,
+                        updated_at,
+                    ],
+                )
+            )
+        for gone in set(self.check_hashes) - set(new_check_hashes):
+            check_stats.deleted += 1
+            statements.append(
+                ("DELETE FROM __corro_consul_checks WHERE id = ?", [gone])
+            )
+            statements.append(
+                (
+                    "DELETE FROM consul_checks WHERE node = ? AND id = ?",
+                    [self.node, gone],
+                )
+            )
+
+        if statements:
+            # one transaction: hash-table writes + CRDT upserts together
+            await self.corrosion.execute(statements)
+        self.service_hashes = new_svc_hashes
+        self.check_hashes = new_check_hashes
+        return svc_stats, check_stats
+
+    async def run(self, interval: float = CONSUL_PULL_INTERVAL) -> None:
+        """The 1 s poll loop (ref: sync.rs:91-120); cancel to stop."""
+        await self.setup()
+        await self.load_hashes()
+        while True:
+            try:
+                svc_stats, check_stats = await self.update()
+                if not svc_stats.is_zero():
+                    logger.info("updated consul services: %s", svc_stats)
+                if not check_stats.is_zero():
+                    logger.info("updated consul checks: %s", check_stats)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.error("non-fatal consul update error: %s", e)
+            await asyncio.sleep(interval)
+
+
+def _as_bytes(v: Any) -> bytes:
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    if isinstance(v, dict) and "blob" in v:
+        return bytes.fromhex(v["blob"])
+    if isinstance(v, str):
+        return bytes.fromhex(v)
+    raise ConsulSyncError(f"unexpected hash cell: {v!r}")

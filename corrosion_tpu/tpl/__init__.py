@@ -1,0 +1,241 @@
+"""Template engine: SQL-driven config rendering.
+
+Equivalent of crates/corro-tpl/ (+ the external ``rhai_tpl`` crate): text
+templates with embedded script blocks —
+
+- ``<%= expr %>``  writes the expression's value
+- ``<% stmt %>``   runs a statement (control flow spans blocks)
+
+The scripting language is Python (the reference scripts in Rhai; a
+TPU-era Python stack scripts in Python).  Rhai-style braces are accepted
+so reference templates port mechanically: a trailing ``{`` opens a block,
+``}`` closes it, ``} else {`` / ``} else if … {`` chain
+(corro-tpl/src/lib.rs:38-127; examples/fly/templates/todos.rhai).
+
+Template context (ref: the engine's registered functions,
+corro-tpl/src/lib.rs:487-601):
+
+- ``sql("SELECT …")``  → :class:`QueryResponse`, iterable of :class:`Row`
+  (attribute access per column), with ``.to_json(pretty=…,
+  row_values_as_array=…)`` and ``.to_csv()``
+- ``hostname()``
+- ``is_null(v)`` / ``Row.<col> is None`` for NULL tests
+
+Rendering records every executed SQL query so the watch loop
+(tpl/watch.py) can subscribe to them and hot re-render on changes.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import re
+import socket
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Engine",
+    "QueryResponse",
+    "Row",
+    "TemplateError",
+    "compile_template",
+]
+
+
+class TemplateError(Exception):
+    pass
+
+
+# -- query results ----------------------------------------------------------
+
+
+class Row:
+    """One result row with attribute access by column name."""
+
+    __slots__ = ("_columns", "_cells")
+
+    def __init__(self, columns: Dict[str, int], cells: Sequence[Any]) -> None:
+        self._columns = columns
+        self._cells = cells
+
+    def __getattr__(self, name: str) -> Any:
+        idx = self._columns.get(name)
+        if idx is None:
+            raise TemplateError(f"no such column: {name}")
+        return self._cells[idx]
+
+    def __getitem__(self, key) -> Any:
+        if isinstance(key, int):
+            return self._cells[key]
+        return self.__getattr__(key)
+
+    def get(self, name: str, default: Any = None) -> Any:
+        idx = self._columns.get(name)
+        return self._cells[idx] if idx is not None else default
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {c: self._cells[i] for c, i in self._columns.items()}
+
+
+class QueryResponse:
+    """A query's result set (ref: QueryResponse, corro-tpl lib.rs:44-81)."""
+
+    def __init__(self, columns: List[str], rows: List[List[Any]]) -> None:
+        self.columns = columns
+        self.rows = rows
+        self._index = {c: i for i, c in enumerate(columns)}
+
+    def __iter__(self) -> Iterator[Row]:
+        return (Row(self._index, cells) for cells in self.rows)
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def to_json(
+        self, pretty: bool = False, row_values_as_array: bool = False
+    ) -> str:
+        if row_values_as_array:
+            out: Any = self.rows
+        else:
+            out = [dict(zip(self.columns, cells)) for cells in self.rows]
+        return json.dumps(out, indent=2 if pretty else None)
+
+    def to_csv(self) -> str:
+        buf = io.StringIO()
+        w = csv.writer(buf)
+        w.writerow(self.columns)
+        w.writerows(self.rows)
+        return buf.getvalue()
+
+
+# -- compiler ---------------------------------------------------------------
+
+_TAG_RE = re.compile(r"<%(=?)(.*?)%>", re.S)
+
+import builtins as _builtins
+
+_SAFE_BUILTINS = {
+    name: getattr(_builtins, name)
+    for name in (
+        "abs", "all", "any", "bool", "dict", "enumerate", "filter", "float",
+        "format", "int", "len", "list", "map", "max", "min", "range",
+        "repr", "reversed", "round", "set", "sorted", "str", "sum", "tuple",
+        "zip",
+    )
+}
+
+
+def _out(value: Any) -> str:
+    return "" if value is None else str(value)
+
+
+def _normalize_stmt(code: str) -> Tuple[List[str], int, bool]:
+    """Translate one ``<% %>`` block into (lines, dedent_first, indent_after),
+    accepting both Python-style (``:`` / ``end``) and Rhai-style braces."""
+    code = code.strip()
+    # brace-style normalization
+    if code in ("}", "end"):
+        return [], 1, False
+    m = re.fullmatch(r"\}\s*else\s*\{", code)
+    if m:
+        return ["else:"], 1, True
+    m = re.fullmatch(r"\}\s*else\s+if\s+(.*?)\s*\{", code)
+    if m:
+        return [f"elif {m.group(1)}:"], 1, True
+    if code.endswith("{"):
+        body = code[:-1].rstrip()
+        return [f"{body}:"], 0, True
+    # python-style
+    if re.fullmatch(r"(else|elif\s+.*|except.*|finally)\s*:", code):
+        return [code], 1, True
+    if code.endswith(":"):
+        return [code], 0, True
+    return code.splitlines(), 0, False
+
+
+def compile_template(text: str, name: str = "<template>"):
+    """Compile template text to a code object executing the render."""
+    src: List[str] = ["def __render__(__emit__, __ctx__):", "    __nop__ = 0"]
+    indent = 1
+
+    def add(line: str, level: int) -> None:
+        src.append("    " * level + line)
+
+    pos = 0
+    for m in _TAG_RE.finditer(text):
+        literal = text[pos : m.start()]
+        if literal:
+            add(f"__emit__({literal!r})", indent)
+        pos = m.end()
+        is_expr, code = m.group(1), m.group(2)
+        if is_expr:
+            add(f"__emit__(__out__({code.strip()}))", indent)
+            continue
+        lines, dedent, indent_after = _normalize_stmt(code)
+        if dedent:
+            indent -= dedent
+            if indent < 1:
+                raise TemplateError("unbalanced block close")
+        for line in lines:
+            add(line.strip(), indent)
+        if indent_after:
+            indent += 1
+    if indent != 1:
+        raise TemplateError("unclosed block at end of template")
+    tail = text[pos:]
+    if tail:
+        add(f"__emit__({tail!r})", 1)
+
+    module = "\n".join(src)
+    try:
+        code_obj = compile(module, name, "exec")
+    except SyntaxError as e:
+        raise TemplateError(f"template compile error: {e}") from e
+    return code_obj
+
+
+class Engine:
+    """Render templates against a SQL query function.
+
+    ``query_fn(sql_text) -> (columns, rows)`` — typically a synchronous
+    bridge to the HTTP client's streaming query (the watch loop supplies
+    one; tests can pass a local function).
+    """
+
+    def __init__(self, query_fn: Callable[[str], Tuple[List[str], List[List[Any]]]]):
+        self.query_fn = query_fn
+
+    def render(
+        self, template, extra_context: Optional[Dict[str, Any]] = None
+    ) -> Tuple[str, List[str]]:
+        """Render; returns (output, list of SQL queries executed)."""
+        if isinstance(template, str):
+            template = compile_template(template)
+        chunks: List[str] = []
+        queries: List[str] = []
+
+        def sql(query_text: str) -> QueryResponse:
+            queries.append(query_text)
+            columns, rows = self.query_fn(query_text)
+            return QueryResponse(columns, rows)
+
+        context: Dict[str, Any] = {
+            "__builtins__": _SAFE_BUILTINS,
+            "__out__": _out,
+            "sql": sql,
+            "hostname": socket.gethostname,
+            "is_null": lambda v: v is None,
+            "json": json,
+        }
+        if extra_context:
+            context.update(extra_context)
+        namespace: Dict[str, Any] = dict(context)
+        exec(template, namespace)  # defines __render__
+        try:
+            namespace["__render__"](chunks.append, namespace)
+        except TemplateError:
+            raise
+        except Exception as e:
+            raise TemplateError(f"template render error: {e}") from e
+        return "".join(chunks), queries

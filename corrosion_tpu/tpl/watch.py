@@ -1,0 +1,170 @@
+"""Template watch loop: render, atomically replace, re-render on change.
+
+Equivalent of crates/corrosion/src/command/tpl.rs:29-120: each
+``src:dst[:cmd]`` spec is rendered to a tempfile and atomically swapped
+into place (``os.replace``), optionally running a command after each
+render; the loop re-renders when
+
+- the source template file changes (mtime poll — the reference uses a
+  notify debouncer), or
+- any SQL query the template executed produces a subscription change
+  event (hot re-render, ref: corro-tpl's subscription-driven
+  QueryResponse).
+
+``once=True`` renders a single time and returns (ref: --once flag).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import os
+import shlex
+import tempfile
+from typing import List, Optional
+
+from . import Engine, TemplateError, compile_template
+
+logger = logging.getLogger(__name__)
+
+MTIME_POLL_INTERVAL = 1.0
+RERENDER_DEBOUNCE = 0.1
+
+
+def parse_template_spec(spec: str) -> tuple:
+    """Split ``src:dst[:cmd]`` (ref: command/tpl.rs splitn(3, ':'))."""
+    parts = spec.split(":", 2)
+    if len(parts) < 2:
+        raise ValueError("template spec must be src:dst[:cmd]")
+    src, dst = parts[0], parts[1]
+    cmd = shlex.split(parts[2]) if len(parts) > 2 and parts[2] else None
+    return src, dst, cmd
+
+
+class TemplateWatcher:
+    """One src:dst[:cmd] render loop bound to an API client."""
+
+    def __init__(
+        self,
+        client,  # CorrosionApiClient
+        src: str,
+        dst: str,
+        cmd: Optional[List[str]] = None,
+        once: bool = False,
+    ) -> None:
+        self.client = client
+        self.src = src
+        self.dst = dst
+        self.cmd = cmd
+        self.once = once
+        self.renders = 0
+        self._wake = asyncio.Event()
+        self._sub_tasks: List[asyncio.Task] = []
+        self._watched: List[str] = []
+
+    # -- rendering ---------------------------------------------------------
+
+    async def render_once(self) -> List[str]:
+        """Render src → dst atomically; returns the queries used."""
+        with open(self.src) as f:
+            text = f.read()
+        compiled = compile_template(text, name=self.src)
+
+        loop = asyncio.get_running_loop()
+
+        def query_sync(sql_text: str):
+            fut = asyncio.run_coroutine_threadsafe(
+                self.client.query_rows(sql_text), loop
+            )
+            return fut.result(timeout=30)
+
+        engine = Engine(query_sync)
+        output, queries = await asyncio.to_thread(engine.render, compiled)
+
+        parent = os.path.dirname(os.path.abspath(self.dst))
+        os.makedirs(parent, exist_ok=True)
+        fd, tmp_path = tempfile.mkstemp(dir=parent, prefix=".tpl-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(output)
+            os.replace(tmp_path, self.dst)  # atomic swap (ref: tpl.rs)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp_path)
+            raise
+        self.renders += 1
+
+        if self.cmd:
+            proc = await asyncio.create_subprocess_exec(*self.cmd)
+            rc = await proc.wait()
+            if rc != 0:
+                logger.warning(
+                    "template command %r exited with %d", self.cmd, rc
+                )
+        return queries
+
+    # -- change sources ----------------------------------------------------
+
+    def _resubscribe(self, queries: List[str]) -> None:
+        """Subscribe to the template's queries; any change event wakes the
+        render loop.  Only re-subscribes when the query set changed."""
+        if queries == self._watched:
+            return
+        for t in self._sub_tasks:
+            t.cancel()
+        self._sub_tasks = [
+            asyncio.create_task(self._watch_query(q)) for q in queries
+        ]
+        self._watched = list(queries)
+
+    async def _watch_query(self, sql_text: str) -> None:
+        try:
+            stream = self.client.subscribe(sql_text, skip_rows=True)
+            async for event in stream:
+                if "change" in event:
+                    self._wake.set()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # subscription unsupported for this query (or server gone):
+            # fall back to the mtime poll only
+            logger.debug("template sub for %r failed: %s", sql_text, e)
+
+    async def _watch_mtime(self) -> None:
+        last = os.stat(self.src).st_mtime_ns
+        while True:
+            await asyncio.sleep(MTIME_POLL_INTERVAL)
+            try:
+                now = os.stat(self.src).st_mtime_ns
+            except FileNotFoundError:
+                continue
+            if now != last:
+                last = now
+                self._wake.set()
+
+    # -- loop --------------------------------------------------------------
+
+    async def run(self) -> None:
+        queries = await self.render_once()
+        if self.once:
+            return
+        self._resubscribe(queries)
+        mtime_task = asyncio.create_task(self._watch_mtime())
+        try:
+            while True:
+                await self._wake.wait()
+                await asyncio.sleep(RERENDER_DEBOUNCE)  # coalesce bursts
+                self._wake.clear()
+                try:
+                    queries = await self.render_once()
+                    self._resubscribe(queries)
+                except (TemplateError, OSError) as e:
+                    logger.error("template render failed: %s", e)
+        finally:
+            mtime_task.cancel()
+            for t in self._sub_tasks:
+                t.cancel()
+            for t in [mtime_task, *self._sub_tasks]:
+                with contextlib.suppress(asyncio.CancelledError):
+                    await t

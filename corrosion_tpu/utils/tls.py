@@ -1,0 +1,162 @@
+"""TLS certificate utilities.
+
+Equivalent of crates/corro-types/src/tls.rs (rcgen-based CA / server /
+client certificate generation) + the ``corrosion tls ca|server|client
+generate`` subcommands (crates/corrosion/src/command/tls.rs): a self-signed
+CA, server certificates with IP/DNS SANs signed by it, and client
+certificates for mTLS signed by a (typically separate) client CA.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+from typing import List, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+CERT_VALIDITY_DAYS = 365 * 5
+
+
+def _new_key() -> ec.EllipticCurvePrivateKey:
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def _name(common_name: str) -> x509.Name:
+    return x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+    )
+
+
+def _pem_key(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+def _pem_cert(cert: x509.Certificate) -> bytes:
+    return cert.public_bytes(serialization.Encoding.PEM)
+
+
+def _window() -> Tuple[datetime.datetime, datetime.datetime]:
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return now - datetime.timedelta(days=1), now + datetime.timedelta(
+        days=CERT_VALIDITY_DAYS
+    )
+
+
+def generate_ca(common_name: str = "corrosion CA") -> Tuple[bytes, bytes]:
+    """Self-signed CA; returns (cert_pem, key_pem) (ref: tls.rs ca gen)."""
+    key = _new_key()
+    not_before, not_after = _window()
+    name = _name(common_name)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(not_before)
+        .not_valid_after(not_after)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True,
+                key_cert_sign=True,
+                crl_sign=True,
+                content_commitment=False,
+                key_encipherment=False,
+                data_encipherment=False,
+                key_agreement=False,
+                encipher_only=False,
+                decipher_only=False,
+            ),
+            True,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    return _pem_cert(cert), _pem_key(key)
+
+
+def _signed(
+    common_name: str,
+    ca_cert_pem: bytes,
+    ca_key_pem: bytes,
+    eku,
+    sans: Optional[List[str]] = None,
+) -> Tuple[bytes, bytes]:
+    ca_cert = x509.load_pem_x509_certificate(ca_cert_pem)
+    ca_key = serialization.load_pem_private_key(ca_key_pem, password=None)
+    key = _new_key()
+    not_before, not_after = _window()
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(_name(common_name))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(not_before)
+        .not_valid_after(not_after)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None), True)
+        .add_extension(x509.ExtendedKeyUsage([eku]), False)
+    )
+    if sans:
+        entries: List[x509.GeneralName] = []
+        for san in sans:
+            try:
+                entries.append(
+                    x509.IPAddress(ipaddress.ip_address(san))
+                )
+            except ValueError:
+                entries.append(x509.DNSName(san))
+        builder = builder.add_extension(
+            x509.SubjectAlternativeName(entries), False
+        )
+    cert = builder.sign(ca_key, hashes.SHA256())
+    return _pem_cert(cert), _pem_key(key)
+
+
+def generate_server_cert(
+    ca_cert_pem: bytes, ca_key_pem: bytes, addrs: List[str]
+) -> Tuple[bytes, bytes]:
+    """Server certificate with IP/DNS SANs signed by the CA
+    (ref: tls.rs server cert gen; command/tls.rs server generate)."""
+    return _signed(
+        addrs[0] if addrs else "corrosion server",
+        ca_cert_pem,
+        ca_key_pem,
+        ExtendedKeyUsageOID.SERVER_AUTH,
+        sans=addrs,
+    )
+
+
+def generate_client_cert(
+    ca_cert_pem: bytes, ca_key_pem: bytes, common_name: str = "corrosion client"
+) -> Tuple[bytes, bytes]:
+    """Client certificate for mTLS (ref: command/tls.rs client generate)."""
+    return _signed(
+        common_name, ca_cert_pem, ca_key_pem, ExtendedKeyUsageOID.CLIENT_AUTH
+    )
+
+
+def write_pair(
+    cert_pem: bytes, key_pem: bytes, cert_path: str, key_path: str
+) -> None:
+    for path, data, mode in (
+        (cert_path, cert_pem, 0o644),
+        (key_path, key_pem, 0o600),
+    ):
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        # create with the final mode: the private key must never be
+        # world-readable, not even between write and chmod
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.chmod(path, mode)  # in case the file pre-existed wider

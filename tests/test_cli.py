@@ -1,0 +1,227 @@
+"""CLI integration tests (ref: integration-tests/tests/cli_test.rs — drive
+the real binary against a live agent; command table main.rs:578-653)."""
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, "
+    'text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;'
+)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def cli(args, config=None, timeout=60, check=True):
+    cmd = [sys.executable, "-m", "corrosion_tpu.cli"]
+    if config:
+        cmd += ["-c", str(config)]
+    cmd += args
+    proc = subprocess.run(
+        cmd,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"cli {args} failed ({proc.returncode}):\n{proc.stdout}\n{proc.stderr}"
+        )
+    return proc
+
+
+@pytest.fixture(scope="module")
+def live_agent(tmp_path_factory):
+    """One real agent subprocess shared by the CLI tests."""
+    tmp = tmp_path_factory.mktemp("cli")
+    api_port = free_port()
+    gossip_port = free_port()
+    schema_path = tmp / "schema.sql"
+    schema_path.write_text(SCHEMA)
+    config_path = tmp / "config.toml"
+    config_path.write_text(
+        f"""
+[db]
+path = "{tmp / 'node.db'}"
+schema_paths = ["{schema_path}"]
+
+[api]
+addr = "127.0.0.1:{api_port}"
+
+[gossip]
+addr = "127.0.0.1:{gossip_port}"
+
+[admin]
+uds_path = "{tmp / 'admin.sock'}"
+"""
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "corrosion_tpu.cli", "-c", str(config_path), "agent"],
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    # wait for the admin socket to come up
+    deadline = time.monotonic() + 30
+    admin_sock = tmp / "admin.sock"
+    while time.monotonic() < deadline:
+        if admin_sock.exists():
+            break
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"agent died: {proc.stdout.read()}\n{proc.stderr.read()}"
+            )
+        time.sleep(0.1)
+    else:
+        proc.kill()
+        raise AssertionError("agent never created the admin socket")
+    yield {"config": config_path, "tmp": tmp, "api_port": api_port}
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_exec_and_query(live_agent):
+    cfg = live_agent["config"]
+    cli(
+        ["exec", "INSERT INTO tests (id, text) VALUES (?, ?)",
+         "--param", "1", "--param", "hello"],
+        config=cfg,
+    )
+    out = cli(
+        ["query", "SELECT id, text FROM tests", "--columns"], config=cfg
+    ).stdout
+    lines = out.strip().splitlines()
+    assert lines[0] == "id\ttext"
+    assert lines[1] == "1\thello"
+
+
+def test_query_error_exits_nonzero(live_agent):
+    proc = cli(
+        ["query", "SELECT nope FROM missing"],
+        config=live_agent["config"],
+        check=False,
+    )
+    assert proc.returncode == 1
+    assert "error" in proc.stderr.lower()
+
+
+def test_admin_subcommands(live_agent):
+    cfg = live_agent["config"]
+    out = cli(["sync", "generate"], config=cfg).stdout
+    state = json.loads(out)
+    assert "heads" in state and "need" in state
+
+    out = cli(["actor", "version"], config=cfg).stdout
+    assert json.loads(out)["actor_id"]
+
+    out = cli(["locks", "--top", "3"], config=cfg).stdout
+    assert isinstance(json.loads(out), list)
+
+    out = cli(["cluster", "membership-states"], config=cfg).stdout
+    assert isinstance(json.loads(out), list)
+
+    out = cli(["compact-empties"], config=cfg).stdout
+    assert isinstance(json.loads(out), dict)
+
+
+def test_reload_schema(live_agent):
+    cfg = live_agent["config"]
+    out = cli(["reload"], config=cfg).stdout
+    assert "reloaded schema" in out
+
+
+def test_backup_and_restore_refusal(live_agent):
+    cfg = live_agent["config"]
+    tmp = live_agent["tmp"]
+    backup_path = tmp / "backup.db"
+    cli(["backup", str(backup_path)], config=cfg)
+    assert backup_path.exists()
+
+    # restore must refuse while the agent is running
+    proc = cli(["restore", str(backup_path)], config=cfg, check=False)
+    assert proc.returncode == 1
+    assert "currently running" in proc.stderr
+
+
+def test_template_once(live_agent):
+    cfg = live_agent["config"]
+    tmp = live_agent["tmp"]
+    src = tmp / "t.tpl"
+    dst = tmp / "t.out"
+    src.write_text(
+        '<% for r in sql("SELECT id, text FROM tests ORDER BY id"): %>'
+        "<%= r.id %>=<%= r.text %>\n<% end %>"
+    )
+    cli(["template", f"{src}:{dst}", "--once"], config=cfg)
+    assert dst.read_text() == "1=hello\n"
+
+
+def test_tls_generation(tmp_path):
+    import ssl
+
+    ca_cert = tmp_path / "ca_cert.pem"
+    ca_key = tmp_path / "ca_key.pem"
+    cli(
+        ["tls", "ca", "--cert", str(ca_cert), "--key", str(ca_key)],
+        config=None,
+    )
+    assert b"BEGIN CERTIFICATE" in ca_cert.read_bytes()
+    assert oct(ca_key.stat().st_mode & 0o777) == oct(0o600)
+
+    server_cert = tmp_path / "server_cert.pem"
+    server_key = tmp_path / "server_key.pem"
+    cli(
+        [
+            "tls", "server", "127.0.0.1", "node1.example.com",
+            "--ca-cert", str(ca_cert), "--ca-key", str(ca_key),
+            "--cert", str(server_cert), "--key", str(server_key),
+        ],
+        config=None,
+    )
+    client_cert = tmp_path / "client_cert.pem"
+    client_key = tmp_path / "client_key.pem"
+    cli(
+        [
+            "tls", "client",
+            "--ca-cert", str(ca_cert), "--ca-key", str(ca_key),
+            "--cert", str(client_cert), "--key", str(client_key),
+        ],
+        config=None,
+    )
+
+    # the generated chain actually validates: server cert against the CA
+    ctx = ssl.create_default_context(cafile=str(ca_cert))
+    from cryptography import x509
+
+    cert = x509.load_pem_x509_certificate(server_cert.read_bytes())
+    sans = cert.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName
+    ).value
+    assert "node1.example.com" in sans.get_values_for_type(x509.DNSName)
+    ca = x509.load_pem_x509_certificate(ca_cert.read_bytes())
+    cert.verify_directly_issued_by(ca)
+    x509.load_pem_x509_certificate(
+        client_cert.read_bytes()
+    ).verify_directly_issued_by(ca)

@@ -1,0 +1,247 @@
+"""Consul sync tests (ref: the tests at the bottom of
+crates/corrosion/src/command/consul/sync.rs — hash-diffed upserts/deletes
+through the corrosion API against a fake Consul agent)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+
+from corrosion_tpu.agent import Agent, AgentConfig
+from corrosion_tpu.api.http import Api
+from corrosion_tpu.client import CorrosionApiClient
+from corrosion_tpu.consul import (
+    AgentCheck,
+    AgentService,
+    ConsulClient,
+    ConsulSync,
+    ConsulSyncError,
+    hash_check,
+    hash_service,
+)
+from corrosion_tpu.types.schema import apply_schema
+
+CONSUL_SCHEMA = """
+CREATE TABLE consul_services (
+    node TEXT NOT NULL,
+    id TEXT NOT NULL,
+    name TEXT NOT NULL DEFAULT '',
+    tags TEXT NOT NULL DEFAULT '[]',
+    meta TEXT NOT NULL DEFAULT '{}',
+    port INTEGER NOT NULL DEFAULT 0,
+    address TEXT NOT NULL DEFAULT '',
+    updated_at INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (node, id)
+);
+CREATE TABLE consul_checks (
+    node TEXT NOT NULL,
+    id TEXT NOT NULL,
+    service_id TEXT NOT NULL DEFAULT '',
+    service_name TEXT NOT NULL DEFAULT '',
+    name TEXT NOT NULL DEFAULT '',
+    status TEXT NOT NULL DEFAULT '',
+    output TEXT NOT NULL DEFAULT '',
+    updated_at INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (node, id)
+);
+"""
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class FakeConsul:
+    """A fake Consul agent HTTP endpoint."""
+
+    def __init__(self):
+        self.services = {}
+        self.checks = {}
+        self.runner = None
+        self.base = None
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get(
+            "/v1/agent/services",
+            lambda r: web.json_response(self.services),
+        )
+        app.router.add_get(
+            "/v1/agent/checks", lambda r: web.json_response(self.checks)
+        )
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.base = f"http://127.0.0.1:{port}"
+        return self
+
+    async def stop(self):
+        await self.runner.cleanup()
+
+
+async def boot():
+    agent = Agent(AgentConfig(db_path=":memory:", read_conns=2)).open_sync()
+    await agent.pool.write_call(lambda c: apply_schema(c, CONSUL_SCHEMA))
+    api = Api(agent)
+    port = await api.start()
+    return agent, api, f"http://127.0.0.1:{port}"
+
+
+def test_hash_service_stability():
+    a = AgentService(id="s1", name="web", tags=["a", "b"], port=80)
+    b = AgentService(id="s1", name="web", tags=["b", "a"], port=80)
+    assert hash_service(a) == hash_service(b)  # tag order irrelevant
+    c = AgentService(id="s1", name="web", tags=["a"], port=80)
+    assert hash_service(a) != hash_service(c)
+
+
+def test_hash_check_directives():
+    base = dict(id="c1", service_id="s1", service_name="web")
+    plain = AgentCheck(**base, status="passing", output="x")
+    # without directives, output changes don't affect the hash
+    assert hash_check(plain) == hash_check(
+        AgentCheck(**base, status="passing", output="y")
+    )
+    assert hash_check(plain) != hash_check(
+        AgentCheck(**base, status="critical", output="x")
+    )
+    # with the output directive, output changes do
+    notes = json.dumps({"hash_include": ["status", "output"]})
+    w1 = AgentCheck(**base, status="passing", output="x", notes=notes)
+    w2 = AgentCheck(**base, status="passing", output="y", notes=notes)
+    assert hash_check(w1) != hash_check(w2)
+
+
+def test_sync_upserts_diffs_and_deletes():
+    async def main():
+        agent, api, base = await boot()
+        consul = await FakeConsul().start()
+        consul.services["web"] = {
+            "ID": "web",
+            "Service": "web",
+            "Tags": ["http"],
+            "Port": 8080,
+            "Address": "10.0.0.1",
+        }
+        consul.checks["web-check"] = {
+            "CheckID": "web-check",
+            "Name": "web alive",
+            "Status": "passing",
+            "Output": "ok",
+            "ServiceID": "web",
+            "ServiceName": "web",
+        }
+        async with CorrosionApiClient(base) as corrosion:
+            sync = ConsulSync(
+                ConsulClient(consul.base), corrosion, node="test-node"
+            )
+            await sync.setup()
+            await sync.load_hashes()
+
+            svc_stats, check_stats = await sync.update(updated_at=1000)
+            assert (svc_stats.upserted, svc_stats.deleted) == (1, 0)
+            assert (check_stats.upserted, check_stats.deleted) == (1, 0)
+
+            _, rows = await corrosion.query_rows(
+                "SELECT node, id, name, tags, port, address, updated_at "
+                "FROM consul_services"
+            )
+            assert rows == [
+                ["test-node", "web", "web", '["http"]', 8080, "10.0.0.1", 1000]
+            ]
+
+            # unchanged world → no writes
+            svc_stats, check_stats = await sync.update(updated_at=2000)
+            assert svc_stats.is_zero() and check_stats.is_zero()
+            _, rows = await corrosion.query_rows(
+                "SELECT updated_at FROM consul_services"
+            )
+            assert rows == [[1000]]  # untouched
+
+            # flapping output w/o directives → still no writes
+            consul.checks["web-check"]["Output"] = "ok (2 checks)"
+            _, check_stats = await sync.update(updated_at=3000)
+            assert check_stats.is_zero()
+
+            # status change → check row updated
+            consul.checks["web-check"]["Status"] = "critical"
+            _, check_stats = await sync.update(updated_at=4000)
+            assert check_stats.upserted == 1
+            _, rows = await corrosion.query_rows(
+                "SELECT status, updated_at FROM consul_checks"
+            )
+            assert rows == [["critical", 4000]]
+
+            # service deregistered → both tables cleaned
+            del consul.services["web"]
+            svc_stats, _ = await sync.update(updated_at=5000)
+            assert svc_stats.deleted == 1
+            _, rows = await corrosion.query_rows(
+                "SELECT COUNT(*) FROM consul_services"
+            )
+            assert rows == [[0]]
+            _, rows = await corrosion.query_rows(
+                "SELECT COUNT(*) FROM __corro_consul_services"
+            )
+            assert rows == [[0]]
+
+        await consul.stop()
+        await api.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_hash_reload_prevents_rewrite():
+    """A restarted sync loop re-reads the hash tables and doesn't rewrite
+    unchanged rows (ref: sync.rs:54-88 initial hash population)."""
+
+    async def main():
+        agent, api, base = await boot()
+        consul = await FakeConsul().start()
+        consul.services["db"] = {"ID": "db", "Service": "db", "Port": 5432}
+        async with CorrosionApiClient(base) as corrosion:
+            sync1 = ConsulSync(
+                ConsulClient(consul.base), corrosion, node="n1"
+            )
+            await sync1.setup()
+            await sync1.load_hashes()
+            await sync1.update(updated_at=100)
+
+            # new instance, as after a process restart
+            sync2 = ConsulSync(
+                ConsulClient(consul.base), corrosion, node="n1"
+            )
+            await sync2.setup()
+            await sync2.load_hashes()
+            svc_stats, _ = await sync2.update(updated_at=200)
+            assert svc_stats.is_zero()
+            _, rows = await corrosion.query_rows(
+                "SELECT updated_at FROM consul_services"
+            )
+            assert rows == [[100]]
+        await consul.stop()
+        await api.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_setup_rejects_missing_schema():
+    async def main():
+        agent = Agent(AgentConfig(db_path=":memory:")).open_sync()
+        api = Api(agent)
+        port = await api.start()
+        consul = await FakeConsul().start()
+        async with CorrosionApiClient(f"http://127.0.0.1:{port}") as corrosion:
+            sync = ConsulSync(ConsulClient(consul.base), corrosion)
+            with pytest.raises(ConsulSyncError, match="consul_services"):
+                await sync.setup()
+        await consul.stop()
+        await api.stop()
+        agent.close()
+
+    run(main())

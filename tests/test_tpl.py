@@ -1,0 +1,233 @@
+"""Template engine tests (ref: crates/corro-tpl/ + command/tpl.rs —
+sql()/to_json/to_csv rendering, brace-style porting of Rhai templates,
+watch loop with atomic replace and subscription-driven re-render)."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from corrosion_tpu.agent import Agent, AgentConfig
+from corrosion_tpu.api.http import Api
+from corrosion_tpu.client import CorrosionApiClient
+from corrosion_tpu.pubsub import SubsManager
+from corrosion_tpu.pubsub import matcher as matcher_mod
+from corrosion_tpu.tpl import Engine, QueryResponse, TemplateError, compile_template
+from corrosion_tpu.tpl.watch import TemplateWatcher, parse_template_spec
+
+SCHEMA = (
+    "CREATE TABLE todos (id INTEGER NOT NULL PRIMARY KEY, "
+    'title TEXT NOT NULL DEFAULT "", completed_at INTEGER)'
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def fake_query(rows, columns=("id", "title", "completed_at")):
+    def query_fn(sql_text):
+        return list(columns), [list(r) for r in rows]
+
+    return query_fn
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+def test_expression_and_literal():
+    engine = Engine(fake_query([]))
+    out, queries = engine.render("hello <%= 1 + 1 %> world")
+    assert out == "hello 2 world"
+    assert queries == []
+
+
+def test_sql_iteration_python_style():
+    engine = Engine(fake_query([[1, "write tests", None], [2, "ship", 123]]))
+    out, queries = engine.render(
+        "<% for todo in sql(\"SELECT * FROM todos\"): %>"
+        "[<% if todo.completed_at is None: %> <% else: %>X<% end %>]"
+        " <%= todo.title %>\n"
+        "<% end %>"
+    )
+    assert out == "[ ] write tests\n[X] ship\n"
+    assert queries == ["SELECT * FROM todos"]
+
+
+def test_sql_iteration_rhai_brace_style():
+    """The reference's todos.rhai template ports with braces intact
+    (examples/fly/templates/todos.rhai)."""
+    engine = Engine(fake_query([[1, "a", None], [2, "b", 5]]))
+    out, _ = engine.render(
+        '<% for todo in sql("SELECT title, completed_at FROM todos") { %>'
+        "[<% if is_null(todo.completed_at) { %> <% } else { %>X<% } %>]"
+        " <%= todo.title %>\n"
+        "<% } %>"
+    )
+    assert out == "[ ] a\n[X] b\n"
+
+
+def test_else_if_chain():
+    engine = Engine(fake_query([]))
+    tpl = (
+        "<% x = 2 %>"
+        "<% if x == 1 { %>one<% } else if x == 2 { %>two<% } else { %>many<% } %>"
+    )
+    out, _ = engine.render(tpl)
+    assert out == "two"
+
+
+def test_to_json_and_csv():
+    engine = Engine(fake_query([[1, "a", None]], columns=("id", "title", "done")))
+    out, _ = engine.render('<%= sql("SELECT 1").to_json() %>')
+    assert json.loads(out) == [{"id": 1, "title": "a", "done": None}]
+
+    out, _ = engine.render('<%= sql("SELECT 1").to_json(pretty=True) %>')
+    assert "\n" in out and json.loads(out) == [
+        {"id": 1, "title": "a", "done": None}
+    ]
+
+    out, _ = engine.render(
+        '<%= sql("SELECT 1").to_json(row_values_as_array=True) %>'
+    )
+    assert json.loads(out) == [[1, "a", None]]
+
+    out, _ = engine.render('<%= sql("SELECT 1").to_csv() %>')
+    assert out.splitlines() == ["id,title,done", "1,a,"]
+
+
+def test_hostname_and_none_renders_empty():
+    import socket
+
+    engine = Engine(fake_query([]))
+    out, _ = engine.render("<%= hostname() %>|<%= None %>|")
+    assert out == f"{socket.gethostname()}||"
+
+
+def test_unbalanced_blocks_rejected():
+    with pytest.raises(TemplateError, match="unclosed"):
+        compile_template("<% if True: %>never closed")
+    with pytest.raises(TemplateError, match="unbalanced"):
+        compile_template("<% end %>")
+
+
+def test_render_error_wrapped():
+    engine = Engine(fake_query([[1, "a", None]]))
+    with pytest.raises(TemplateError, match="no such column"):
+        engine.render('<% r = [x for x in sql("q")][0] %><%= r.nope %>')
+
+
+def test_sandbox_has_no_open_or_import():
+    engine = Engine(fake_query([]))
+    with pytest.raises(TemplateError):
+        engine.render("<%= open('/etc/passwd') %>")
+    with pytest.raises(TemplateError):
+        engine.render("<% import os %>")
+
+
+def test_parse_template_spec():
+    assert parse_template_spec("a.tpl:b.conf") == ("a.tpl", "b.conf", None)
+    assert parse_template_spec("a:b:systemctl reload nginx") == (
+        "a",
+        "b",
+        ["systemctl", "reload", "nginx"],
+    )
+    with pytest.raises(ValueError):
+        parse_template_spec("only-src")
+
+
+# ---------------------------------------------------------------------------
+# watch loop against a live node
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def fast_batching(monkeypatch):
+    monkeypatch.setattr(matcher_mod, "CANDIDATE_BATCH_WINDOW", 0.05)
+
+
+async def boot(tmp_path):
+    agent = Agent(AgentConfig(db_path=":memory:", read_conns=2)).open_sync()
+    subs = SubsManager(str(tmp_path / "subs"), agent.pool)
+    subs.start()
+    api = Api(agent, subs=subs)
+    port = await api.start()
+    return agent, subs, api, f"http://127.0.0.1:{port}"
+
+
+def test_watch_renders_and_rerenders_on_change(tmp_path):
+    async def main():
+        agent, subs, api, base = await boot(tmp_path)
+        src = tmp_path / "todos.tpl"
+        dst = tmp_path / "out" / "todos.txt"
+        src.write_text(
+            '<% for t in sql("SELECT title FROM todos ORDER BY id"): %>'
+            "- <%= t.title %>\n<% end %>"
+        )
+        async with CorrosionApiClient(base) as client:
+            await client.schema([SCHEMA])
+            await client.execute(
+                [("INSERT INTO todos (id, title) VALUES (?, ?)", (1, "first"))]
+            )
+            watcher = TemplateWatcher(client, str(src), str(dst))
+            task = asyncio.create_task(watcher.run())
+            try:
+                for _ in range(100):
+                    if dst.exists():
+                        break
+                    await asyncio.sleep(0.05)
+                assert dst.read_text() == "- first\n"
+
+                # a write through the API triggers a subscription-driven
+                # re-render
+                await client.execute(
+                    [
+                        (
+                            "INSERT INTO todos (id, title) VALUES (?, ?)",
+                            (2, "second"),
+                        )
+                    ]
+                )
+                for _ in range(100):
+                    if watcher.renders >= 2 and "second" in dst.read_text():
+                        break
+                    await asyncio.sleep(0.05)
+                assert dst.read_text() == "- first\n- second\n"
+            finally:
+                task.cancel()
+                with pytest.raises(asyncio.CancelledError):
+                    await task
+        await subs.stop()
+        await api.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_watch_once_with_command(tmp_path):
+    async def main():
+        agent, subs, api, base = await boot(tmp_path)
+        src = tmp_path / "t.tpl"
+        dst = tmp_path / "t.out"
+        marker = tmp_path / "ran.marker"
+        src.write_text("static content")
+        async with CorrosionApiClient(base) as client:
+            watcher = TemplateWatcher(
+                client,
+                str(src),
+                str(dst),
+                cmd=["touch", str(marker)],
+                once=True,
+            )
+            await watcher.run()
+        assert dst.read_text() == "static content"
+        assert marker.exists()
+        assert watcher.renders == 1
+        await subs.stop()
+        await api.stop()
+        agent.close()
+
+    run(main())

@@ -63,8 +63,11 @@ async def cmd_agent(args) -> int:
 
     config = load_config(args)
     node = await Node(config).start()
-    api_addr = f"127.0.0.1:{node.api.port}"
-    print(f"agent running: api={api_addr} gossip={node.gossip_addr}")
+    gossip_host, gossip_port = node.gossip_addr
+    print(
+        f"agent running: api=127.0.0.1:{node.api.port} "
+        f"gossip={gossip_host}:{gossip_port}"
+    )
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):

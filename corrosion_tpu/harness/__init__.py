@@ -1,0 +1,322 @@
+"""Dev-cluster harness: spawn an N-node cluster from a topology file.
+
+Equivalent of crates/corro-devcluster/: a topology file of ``A -> B``
+edges (topology/mod.rs:22-50 — an edge means A bootstraps off B), one
+state directory + generated TOML config per node with per-node ports
+(main.rs:106-174), leaf nodes (no bootstraps, pure responders) started
+first.
+
+Two modes:
+
+- :class:`DevCluster` — **in-process**: each node is a full
+  ``agent.node.Node`` on loopback sockets inside the current event loop.
+  This is the fixture multi-node tests build on (the reference's
+  equivalent is ``launch_test_agent``, corro-tests/src/lib.rs:40-72) and
+  the CPU reference harness for the TPU simulator.
+- :class:`SubprocessCluster` — **process-level**: writes per-node config
+  files and spawns real ``python -m corrosion_tpu.cli agent`` processes,
+  like the reference harness spawns ``corrosion`` binaries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "DevCluster",
+    "SubprocessCluster",
+    "Topology",
+    "parse_topology",
+]
+
+_EDGE_RE = re.compile(r"^\s*(\w+)\s*->\s*(\w+)\s*$")
+
+
+def free_port() -> int:
+    """A currently-free loopback TCP/UDP port (bind-and-release)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@dataclass
+class Topology:
+    """node → list of bootstrap targets (ref: topology::Simple)."""
+
+    edges: Dict[str, List[str]] = field(default_factory=dict)
+
+    def add_edge(self, a: str, b: str) -> None:
+        self.edges.setdefault(a, []).append(b)
+        self.edges.setdefault(b, [])
+
+    @property
+    def nodes(self) -> List[str]:
+        return sorted(self.edges)
+
+    def leaves(self) -> List[str]:
+        """Pure responders — no outgoing bootstrap edges; started first
+        (ref: main.rs:160-166)."""
+        return sorted(n for n, out in self.edges.items() if not out)
+
+    def initiators(self) -> List[str]:
+        return sorted(n for n, out in self.edges.items() if out)
+
+
+def parse_topology(text: str) -> Topology:
+    """Parse ``A -> B`` lines (ref: topology/mod.rs parse_edge)."""
+    topo = Topology()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        m = _EDGE_RE.match(stripped)
+        if m is None:
+            raise ValueError(f"bad topology line {lineno}: {line!r}")
+        topo.add_edge(m.group(1), m.group(2))
+    return topo
+
+
+class DevCluster:
+    """In-process cluster of full nodes wired by a topology."""
+
+    def __init__(
+        self,
+        topology: Topology | str,
+        schema: Optional[str] = None,
+        config_tweaks: Optional[dict] = None,
+    ) -> None:
+        if isinstance(topology, str):
+            topology = parse_topology(topology)
+        self.topology = topology
+        self.schema = schema
+        self.config_tweaks = config_tweaks or {}
+        self.nodes: Dict[str, "Node"] = {}  # noqa: F821
+
+    async def start(self) -> "DevCluster":
+        from ..agent.node import Node
+        from ..types.config import Config
+        from ..types.schema import apply_schema
+
+        # pre-assign every node's gossip port so bootstrap lists are
+        # complete regardless of start order (the reference assigns all
+        # ports before generating configs, main.rs:110-115); leaves still
+        # start first so responders are listening before initiators join
+        ports: Dict[str, int] = {
+            name: free_port() for name in self.topology.nodes
+        }
+        order = self.topology.leaves() + self.topology.initiators()
+        for name in order:
+            cfg = Config()
+            cfg.db.path = ":memory:"
+            cfg.gossip.addr = f"127.0.0.1:{ports[name]}"
+            cfg.gossip.bootstrap = [
+                f"127.0.0.1:{ports[peer]}"
+                for peer in self.topology.edges[name]
+            ]
+            # fast timers for test clusters
+            cfg.gossip.probe_period = 0.3
+            cfg.gossip.probe_timeout = 0.15
+            cfg.gossip.suspicion_timeout = 1.0
+            cfg.perf.sync_interval_min = 0.3
+            cfg.perf.sync_interval_max = 1.0
+            for section, values in self.config_tweaks.items():
+                target = getattr(cfg, section)
+                for k, v in values.items():
+                    setattr(target, k, v)
+            node = await Node(cfg).start()
+            if self.schema:
+                await node.agent.pool.write_call(
+                    lambda c, s=self.schema: apply_schema(c, s)
+                )
+            self.nodes[name] = node
+        return self
+
+    async def stop(self) -> None:
+        for node in reversed(list(self.nodes.values())):
+            await node.stop()
+        self.nodes.clear()
+
+    def __getitem__(self, name: str):
+        return self.nodes[name]
+
+    async def __aenter__(self) -> "DevCluster":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    # -- convergence helpers ----------------------------------------------
+
+    async def wait_converged(
+        self, timeout: float = 30.0, interval: float = 0.25
+    ) -> None:
+        """Wait until every node's sync state shows nothing needed and all
+        heads agree (the convergence assertion of
+        ``configurable_stress_test``, agent/tests.rs:464-476)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            states = {
+                name: node.agent.generate_sync()
+                for name, node in self.nodes.items()
+            }
+            heads = [
+                tuple(sorted((a, v) for a, v in s.heads.items()))
+                for s in states.values()
+            ]
+            needs = sum(s.need_len() for s in states.values())
+            if needs == 0 and len(set(heads)) <= 1:
+                return
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(
+                    f"cluster did not converge: needs={needs}, "
+                    f"distinct heads={len(set(heads))}"
+                )
+            await asyncio.sleep(interval)
+
+
+class SubprocessCluster:
+    """Process-level cluster: one real agent process per topology node
+    (ref: corro-devcluster spawning ``corrosion agent`` binaries)."""
+
+    def __init__(
+        self,
+        topology: Topology | str,
+        state_dir: str,
+        schema: str,
+    ) -> None:
+        if isinstance(topology, str):
+            topology = parse_topology(topology)
+        self.topology = topology
+        self.state_dir = state_dir
+        self.schema = schema
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self.api_ports: Dict[str, int] = {}
+        self.admin_socks: Dict[str, str] = {}
+
+    def generate_configs(self) -> Dict[str, str]:
+        """Write per-node state dirs + TOML configs; returns config paths
+        (ref: generate_config, main.rs:117-155)."""
+        ports = {n: free_port() for n in self.topology.nodes}
+        configs: Dict[str, str] = {}
+        for name in self.topology.nodes:
+            node_dir = os.path.join(self.state_dir, name)
+            os.makedirs(node_dir, exist_ok=True)
+            schema_path = os.path.join(node_dir, "schema.sql")
+            with open(schema_path, "w") as f:
+                f.write(self.schema)
+            api_port = free_port()
+            self.api_ports[name] = api_port
+            admin_sock = os.path.join(node_dir, "admin.sock")
+            self.admin_socks[name] = admin_sock
+            bootstrap = ", ".join(
+                f'"127.0.0.1:{ports[peer]}"'
+                for peer in self.topology.edges[name]
+            )
+            config_path = os.path.join(node_dir, "config.toml")
+            with open(config_path, "w") as f:
+                f.write(
+                    f"""
+[db]
+path = "{os.path.join(node_dir, 'node.db')}"
+schema_paths = ["{schema_path}"]
+
+[api]
+addr = "127.0.0.1:{api_port}"
+
+[gossip]
+addr = "127.0.0.1:{ports[name]}"
+bootstrap = [{bootstrap}]
+probe_period = 0.3
+probe_timeout = 0.15
+suspicion_timeout = 1.0
+
+[perf]
+sync_interval_min = 0.3
+sync_interval_max = 1.0
+
+[admin]
+uds_path = "{admin_sock}"
+"""
+                )
+            configs[name] = config_path
+        return configs
+
+    def start(self, startup_timeout: float = 30.0) -> "SubprocessCluster":
+        configs = self.generate_configs()
+        order = self.topology.leaves() + self.topology.initiators()
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        # the directory CONTAINING the corrosion_tpu package (one above
+        # harness/ and the package root) — pointing at the package dir
+        # itself would shadow stdlib modules (types, …) in the children
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        for name in order:
+            log_path = os.path.join(self.state_dir, name, "agent.log")
+            with open(log_path, "wb") as log:
+                self.procs[name] = subprocess.Popen(
+                    [
+                        sys.executable,
+                        "-m",
+                        "corrosion_tpu.cli",
+                        "-c",
+                        configs[name],
+                        "agent",
+                    ],
+                    env=env,
+                    stdout=log,
+                    stderr=subprocess.STDOUT,
+                )
+        deadline = time.monotonic() + startup_timeout
+        for name in order:
+            while not os.path.exists(self.admin_socks[name]):
+                proc = self.procs[name]
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"node {name} exited with {proc.returncode}: "
+                        + self._tail_log(name)
+                    )
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"node {name} never came up")
+                time.sleep(0.1)
+        return self
+
+    def _tail_log(self, name: str) -> str:
+        log_path = os.path.join(self.state_dir, name, "agent.log")
+        try:
+            with open(log_path) as f:
+                return f.read()[-1000:]
+        except OSError:
+            return "<no log>"
+
+    def stop(self) -> None:
+        for proc in self.procs.values():
+            proc.terminate()
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        self.procs.clear()
+
+    def api_base(self, name: str) -> str:
+        return f"http://127.0.0.1:{self.api_ports[name]}"
+
+    def __enter__(self) -> "SubprocessCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

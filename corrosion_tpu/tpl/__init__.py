@@ -32,6 +32,7 @@ import io
 import json
 import re
 import socket
+import textwrap
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 __all__ = [
@@ -132,26 +133,34 @@ def _out(value: Any) -> str:
 
 def _normalize_stmt(code: str) -> Tuple[List[str], int, bool]:
     """Translate one ``<% %>`` block into (lines, dedent_first, indent_after),
-    accepting both Python-style (``:`` / ``end``) and Rhai-style braces."""
-    code = code.strip()
+    accepting both Python-style (``:`` / ``end``) and Rhai-style braces.
+    Multi-line blocks keep their internal (relative) indentation."""
+    code = code.strip("\n")
+    stripped = code.strip()
     # brace-style normalization
-    if code in ("}", "end"):
+    if stripped in ("}", "end"):
         return [], 1, False
-    m = re.fullmatch(r"\}\s*else\s*\{", code)
+    m = re.fullmatch(r"\}\s*else\s*\{", stripped)
     if m:
         return ["else:"], 1, True
-    m = re.fullmatch(r"\}\s*else\s+if\s+(.*?)\s*\{", code)
+    m = re.fullmatch(r"\}\s*else\s+if\s+(.*?)\s*\{", stripped)
     if m:
         return [f"elif {m.group(1)}:"], 1, True
-    if code.endswith("{"):
-        body = code[:-1].rstrip()
+    if stripped.endswith("{") and "\n" not in stripped:
+        body = stripped[:-1].rstrip()
         return [f"{body}:"], 0, True
     # python-style
-    if re.fullmatch(r"(else|elif\s+.*|except.*|finally)\s*:", code):
-        return [code], 1, True
-    if code.endswith(":"):
-        return [code], 0, True
-    return code.splitlines(), 0, False
+    if re.fullmatch(r"(else|elif\s+.*|except.*|finally)\s*:", stripped):
+        return [stripped], 1, True
+    if stripped.endswith(":") and "\n" not in stripped:
+        return [stripped], 0, True
+    # multi-line (or plain) statement block: dedent as a unit so nested
+    # control flow inside one tag survives; the block must be
+    # self-contained (it can't open an indent for later tags)
+    lines = [
+        line for line in textwrap.dedent(code).splitlines() if line.strip()
+    ]
+    return lines, 0, False
 
 
 def compile_template(text: str, name: str = "<template>"):
@@ -178,7 +187,7 @@ def compile_template(text: str, name: str = "<template>"):
             if indent < 1:
                 raise TemplateError("unbalanced block close")
         for line in lines:
-            add(line.strip(), indent)
+            add(line, indent)  # lines keep their relative indentation
         if indent_after:
             indent += 1
     if indent != 1:

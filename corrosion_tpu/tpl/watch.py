@@ -119,17 +119,39 @@ class TemplateWatcher:
         self._watched = list(queries)
 
     async def _watch_query(self, sql_text: str) -> None:
-        try:
-            stream = self.client.subscribe(sql_text, skip_rows=True)
-            async for event in stream:
-                if "change" in event:
-                    self._wake.set()
-        except asyncio.CancelledError:
-            raise
-        except Exception as e:
-            # subscription unsupported for this query (or server gone):
-            # fall back to the mtime poll only
-            logger.debug("template sub for %r failed: %s", sql_text, e)
+        from ..client import ClientError
+        from ..client.sub import MissedChange
+
+        backoff = 1.0
+        while True:
+            try:
+                stream = self.client.subscribe(sql_text, skip_rows=True)
+                async for event in stream:
+                    if "change" in event:
+                        self._wake.set()
+                        backoff = 1.0
+            except asyncio.CancelledError:
+                raise
+            except MissedChange:
+                # history purged past our position: a fresh subscribe
+                # resnapshots; re-render since we may have missed events
+                logger.warning(
+                    "template sub for %r missed changes; resubscribing",
+                    sql_text,
+                )
+                self._wake.set()
+                continue
+            except ClientError as e:
+                # the server rejected the query (not subscribable):
+                # permanent — fall back to the mtime poll only
+                logger.warning("template sub for %r rejected: %s", sql_text, e)
+                return
+            except Exception as e:
+                logger.warning(
+                    "template sub for %r failed (%s); retrying", sql_text, e
+                )
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 15.0)
 
     async def _watch_mtime(self) -> None:
         last = os.stat(self.src).st_mtime_ns
@@ -150,6 +172,10 @@ class TemplateWatcher:
         if self.once:
             return
         self._resubscribe(queries)
+        # a write can land between the first render's query and the
+        # subscription being registered; one immediate re-render after
+        # subscribing closes that window
+        self._wake.set()
         mtime_task = asyncio.create_task(self._watch_mtime())
         try:
             while True:

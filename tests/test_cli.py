@@ -19,12 +19,7 @@ SCHEMA = (
 )
 
 
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from corrosion_tpu.harness import free_port  # noqa: E402
 
 
 def cli(args, config=None, timeout=60, check=True):

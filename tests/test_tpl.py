@@ -107,6 +107,20 @@ def test_hostname_and_none_renders_empty():
     assert out == f"{socket.gethostname()}||"
 
 
+def test_multiline_block_with_nested_control_flow():
+    engine = Engine(fake_query([]))
+    out, _ = engine.render(
+        "<%\n"
+        "items = []\n"
+        "for i in range(3):\n"
+        "    if i != 1:\n"
+        "        items.append(i * 10)\n"
+        "%>"
+        "<%= items %>"
+    )
+    assert out == "[0, 20]"
+
+
 def test_unbalanced_blocks_rejected():
     with pytest.raises(TemplateError, match="unclosed"):
         compile_template("<% if True: %>never closed")

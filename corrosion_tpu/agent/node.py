@@ -60,6 +60,7 @@ class Node:
         self.api: Optional[Api] = None
         self.subs: Optional[SubsManager] = None
         self.admin = None  # AdminServer when config.admin.uds_path is set
+        self.pg = None  # PgServer when config.api.pg_addr is set
         self._tasks: List[asyncio.Task] = []
         self._subs_tmpdir = None  # TemporaryDirectory for :memory: nodes
         self._started = False
@@ -143,6 +144,17 @@ class Node:
             self.admin = AdminServer(self, self.config.admin.uds_path)
             await self.admin.start()
 
+        if self.config.api.pg_addr:
+            from ..pg import PgServer
+
+            pg_host, pg_port = parse_addr(self.config.api.pg_addr)
+            self.pg = PgServer(
+                self.agent,
+                broadcast_hook=lambda changes: self.broadcast.enqueue(changes),
+                subs=self.subs,
+            )
+            await self.pg.start(pg_host, pg_port)
+
         self.broadcast.start()
         self.ingest.start()
         self._tasks.append(asyncio.create_task(self._swim_loop()))
@@ -173,6 +185,9 @@ class Node:
         if self.admin is not None:
             await self.admin.stop()
             self.admin = None
+        if self.pg is not None:
+            await self.pg.stop()
+            self.pg = None
         if self.api is not None:
             await self.api.stop()
         if self.transport is not None:

@@ -31,7 +31,15 @@ __all__ = [
 
 
 class ClientError(Exception):
-    """An API-level error (non-2xx response or error event)."""
+    """An API-level error (non-2xx response or error event).
+
+    ``status`` carries the HTTP status when one applies (None for
+    stream-level error events), so callers can distinguish permanent
+    rejections (4xx) from transient server trouble (5xx)."""
+
+    def __init__(self, message: str, status: Optional[int] = None) -> None:
+        super().__init__(message)
+        self.status = status
 
 
 def _encode_statement(sql: str, params: Any = None) -> Any:
@@ -155,7 +163,9 @@ class CorrosionApiClient:
         ) as resp:
             body = await resp.json()
             if resp.status >= 400:
-                raise ClientError(body.get("error", f"HTTP {resp.status}"))
+                raise ClientError(
+                    body.get("error", f"HTTP {resp.status}"), resp.status
+                )
             return body
 
     # -- reads -------------------------------------------------------------
@@ -170,7 +180,9 @@ class CorrosionApiClient:
         if resp.status >= 400:
             body = await resp.json()
             resp.release()
-            raise ClientError(body.get("error", f"HTTP {resp.status}"))
+            raise ClientError(
+                    body.get("error", f"HTTP {resp.status}"), resp.status
+                )
         return QueryStream(resp)
 
     async def query_rows(
@@ -185,7 +197,9 @@ class CorrosionApiClient:
         ) as resp:
             body = await resp.json()
             if resp.status >= 400:
-                raise ClientError(body.get("error", f"HTTP {resp.status}"))
+                raise ClientError(
+                    body.get("error", f"HTTP {resp.status}"), resp.status
+                )
             return body.get("tables", {})
 
     # -- schema ------------------------------------------------------------
@@ -199,7 +213,9 @@ class CorrosionApiClient:
         ) as resp:
             body = await resp.json()
             if resp.status >= 400:
-                raise ClientError(body.get("error", f"HTTP {resp.status}"))
+                raise ClientError(
+                    body.get("error", f"HTTP {resp.status}"), resp.status
+                )
             return body
 
     async def schema_from_paths(self, paths: Sequence[str]) -> Dict[str, Any]:

@@ -90,7 +90,9 @@ class SubscriptionStream:
             except Exception:
                 body = {}
             resp.release()
-            raise ClientError(body.get("error", f"HTTP {resp.status}"))
+            raise ClientError(
+                body.get("error", f"HTTP {resp.status}"), resp.status
+            )
         self.sub_id = resp.headers.get(QUERY_ID_HEADER, self.sub_id)
         return resp
 

@@ -1,0 +1,817 @@
+"""PostgreSQL wire-protocol server.
+
+Equivalent of crates/corro-pg/: a PostgreSQL v3 protocol endpoint speaking
+to the same store — SELECTs served from the read pool, writes routed
+through the same bookkeeping + broadcast path as the HTTP API
+(``make_broadcastable_changes``), so rows written over psql replicate like
+any other write (corro-pg/src/lib.rs:16-23).
+
+Implemented surface:
+
+- startup: StartupMessage, SSLRequest (declined), AuthenticationOk,
+  ParameterStatus, BackendKeyData, ReadyForQuery
+- simple query protocol (``Q``) with multi-statement scripts
+- extended protocol: Parse / Bind / Describe / Execute / Close / Sync /
+  Flush, named statements + portals, ``$N`` parameters (text and common
+  binary formats in, text out)
+- transactions: ``BEGIN`` buffers writes and ``COMMIT`` applies them as
+  ONE corrosion version (the same all-or-nothing unit the HTTP
+  ``/v1/transactions`` endpoint produces); ``ROLLBACK`` discards.  A
+  multi-statement simple-query message is likewise one implicit
+  transaction: nothing before a failing statement persists.  In both
+  cases reads inside the open block see the pre-transaction snapshot —
+  writes land at commit (documented divergence: the reference executes
+  eagerly on the write connection, so its in-block reads see in-block
+  writes).
+- introspection shims: ``SELECT version()``, ``SET``/``SHOW``, and empty
+  ``pg_catalog`` relations (the reference implements pg_type/pg_class/…
+  as virtual tables, corro-pg/src/vtab/)
+
+SQL translation is intentionally light (``$N`` → ``?N`` and type-cast
+stripping): SQLite accepts the bulk of the PG dialect the reference's
+sqlparser pass emits.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import re
+import secrets
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..agent import Agent, make_broadcastable_changes
+
+logger = logging.getLogger(__name__)
+
+PROTOCOL_VERSION = 196608  # 3.0
+SSL_REQUEST_CODE = 80877103
+CANCEL_REQUEST_CODE = 80877102
+GSSENC_REQUEST_CODE = 80877104
+
+# type OIDs
+OID_BOOL = 16
+OID_BYTEA = 17
+OID_INT8 = 20
+OID_INT4 = 23
+OID_TEXT = 25
+OID_FLOAT8 = 701
+
+_READ_PREFIXES = ("select", "values", "pragma", "explain")
+_WRITE_WORDS = frozenset(
+    ("insert", "update", "delete", "replace", "create", "drop", "alter")
+)
+
+
+class PgProtocolError(Exception):
+    pass
+
+
+# -- SQL translation --------------------------------------------------------
+
+_PARAM_RE = re.compile(r"\$(\d+)")
+# one type word, optionally 'double precision'/'character varying' style
+# second words, size args, and array suffix — must NOT cross clause words
+_CAST_RE = re.compile(
+    r"::\s*[a-zA-Z_][a-zA-Z0-9_]*"
+    r"(?:\s+(?:precision|varying))?"
+    r"(?:\(\d+(?:\s*,\s*\d+)?\))?"
+    r"(?:\[\])?"
+)
+_PG_CATALOG_RE = re.compile(
+    r"\b(pg_catalog\.|pg_type\b|pg_class\b|pg_namespace\b|pg_database\b|"
+    r"pg_range\b|pg_attribute\b|pg_proc\b|information_schema\.)",
+    re.I,
+)
+
+
+def translate_sql(sql: str) -> str:
+    """PG dialect → SQLite: ``$N`` params and ``::cast`` stripping
+    (ref: corro-pg's sqlparser translation pass)."""
+    sql = _PARAM_RE.sub(lambda m: f"?{m.group(1)}", sql)
+    sql = _CAST_RE.sub("", sql)
+    return sql
+
+
+def split_statements(script: str) -> List[str]:
+    """Split a simple-query script on ``;`` outside quotes."""
+    out: List[str] = []
+    buf: List[str] = []
+    quote: Optional[str] = None
+    i = 0
+    while i < len(script):
+        ch = script[i]
+        if quote is not None:
+            buf.append(ch)
+            if ch == quote:
+                if i + 1 < len(script) and script[i + 1] == quote:
+                    buf.append(script[i + 1])
+                    i += 1
+                else:
+                    quote = None
+        elif ch in ("'", '"'):
+            quote = ch
+            buf.append(ch)
+        elif ch == ";":
+            stmt = "".join(buf).strip()
+            if stmt:
+                out.append(stmt)
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    stmt = "".join(buf).strip()
+    if stmt:
+        out.append(stmt)
+    return out
+
+
+def classify(sql: str) -> str:
+    """'read' | 'write' | 'begin' | 'commit' | 'rollback' | 'set' | 'show'."""
+    head = sql.lstrip().split(None, 1)
+    word = head[0].lower() if head else ""
+    if word == "begin" or word == "start":
+        return "begin"
+    if word in ("commit", "end"):
+        return "commit"
+    if word == "rollback":
+        return "rollback"
+    if word in ("set", "reset"):
+        return "set"
+    if word == "show":
+        return "show"
+    if word == "with":
+        # 'WITH … INSERT/UPDATE/DELETE' is a write; find the first
+        # top-level keyword after the CTE list (string/paren aware)
+        return "write" if _with_is_write(sql) else "read"
+    if word in _READ_PREFIXES:
+        return "read"
+    return "write"
+
+
+def _with_is_write(sql: str) -> bool:
+    depth = 0
+    quote: Optional[str] = None
+    for m in re.finditer(r"'|\"|\(|\)|\b[a-zA-Z_]+\b", sql):
+        tok = m.group(0)
+        if quote is not None:
+            if tok == quote:
+                quote = None
+            continue
+        if tok in ("'", '"'):
+            quote = tok
+        elif tok == "(":
+            depth += 1
+        elif tok == ")":
+            depth = max(0, depth - 1)
+        elif depth == 0:
+            low = tok.lower()
+            if low in _WRITE_WORDS:
+                return True
+            if low in ("select", "values"):
+                return False
+    return False
+
+
+def command_tag(sql: str, rowcount: int) -> str:
+    head = sql.lstrip().split(None, 2)
+    word = head[0].upper() if head else "OK"
+    if word == "SELECT":
+        return f"SELECT {rowcount}"
+    if word == "INSERT":
+        return f"INSERT 0 {max(rowcount, 0)}"
+    if word in ("UPDATE", "DELETE"):
+        return f"{word} {max(rowcount, 0)}"
+    if word in ("CREATE", "DROP", "ALTER") and len(head) > 1:
+        return f"{word} {head[1].upper()}"
+    return word
+
+
+# -- value codecs -----------------------------------------------------------
+
+
+def _encode_text(v: Any) -> Optional[bytes]:
+    if v is None:
+        return None
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return b"\\x" + bytes(v).hex().encode()  # bytea text format
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, float):
+        return repr(v).encode()
+    return str(v).encode()
+
+
+def _decode_param(data: Optional[bytes], fmt: int, oid: int) -> Any:
+    if data is None:
+        return None
+    if fmt == 0:  # text
+        text = data.decode()
+        if oid in (OID_INT4, OID_INT8):
+            return int(text)
+        if oid == OID_FLOAT8:
+            return float(text)
+        if oid == OID_BOOL:
+            return 1 if text in ("t", "true", "1") else 0
+        if oid == OID_BYTEA:
+            if text.startswith("\\x"):
+                return bytes.fromhex(text[2:])
+            return text.encode()
+        return text
+    # binary formats for the common OIDs
+    if oid == OID_INT4:
+        return struct.unpack("!i", data)[0]
+    if oid == OID_INT8:
+        return struct.unpack("!q", data)[0]
+    if oid == OID_FLOAT8:
+        return struct.unpack("!d", data)[0]
+    if oid == OID_BOOL:
+        return data[0]
+    if oid in (OID_TEXT,):
+        return data.decode()
+    return bytes(data)  # bytea / unknown
+
+
+def _infer_oid(v: Any) -> int:
+    if isinstance(v, bool):
+        return OID_BOOL
+    if isinstance(v, int):
+        return OID_INT8
+    if isinstance(v, float):
+        return OID_FLOAT8
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return OID_BYTEA
+    return OID_TEXT
+
+
+# -- protocol messages ------------------------------------------------------
+
+
+class MessageWriter:
+    def __init__(self, writer: asyncio.StreamWriter) -> None:
+        self.writer = writer
+
+    def message(self, kind: bytes, payload: bytes = b"") -> None:
+        self.writer.write(kind + struct.pack("!I", len(payload) + 4) + payload)
+
+    def auth_ok(self) -> None:
+        self.message(b"R", struct.pack("!I", 0))
+
+    def parameter_status(self, key: str, value: str) -> None:
+        self.message(b"S", key.encode() + b"\x00" + value.encode() + b"\x00")
+
+    def backend_key_data(self, pid: int, secret: int) -> None:
+        self.message(b"K", struct.pack("!II", pid, secret))
+
+    def ready(self, status: bytes) -> None:
+        self.message(b"Z", status)
+
+    def row_description(
+        self, columns: Sequence[Tuple[str, int]]
+    ) -> None:
+        body = struct.pack("!H", len(columns))
+        for name, oid in columns:
+            body += name.encode() + b"\x00"
+            body += struct.pack("!IhIhih", 0, 0, oid, -1, -1, 0)
+        self.message(b"T", body)
+
+    def data_row(self, cells: Sequence[Any]) -> None:
+        body = struct.pack("!H", len(cells))
+        for cell in cells:
+            encoded = _encode_text(cell)
+            if encoded is None:
+                body += struct.pack("!i", -1)
+            else:
+                body += struct.pack("!i", len(encoded)) + encoded
+        self.message(b"D", body)
+
+    def command_complete(self, tag: str) -> None:
+        self.message(b"C", tag.encode() + b"\x00")
+
+    def empty_query(self) -> None:
+        self.message(b"I")
+
+    def no_data(self) -> None:
+        self.message(b"n")
+
+    def parse_complete(self) -> None:
+        self.message(b"1")
+
+    def bind_complete(self) -> None:
+        self.message(b"2")
+
+    def close_complete(self) -> None:
+        self.message(b"3")
+
+    def parameter_description(self, oids: Sequence[int]) -> None:
+        self.message(
+            b"t",
+            struct.pack("!H", len(oids))
+            + b"".join(struct.pack("!I", o) for o in oids),
+        )
+
+    def error(self, message: str, code: str = "XX000") -> None:
+        body = (
+            b"SERROR\x00"
+            + b"C" + code.encode() + b"\x00"
+            + b"M" + message.encode() + b"\x00"
+            + b"\x00"
+        )
+        self.message(b"E", body)
+
+
+@dataclass
+class Prepared:
+    sql: str  # translated
+    raw_sql: str
+    param_oids: List[int]
+
+
+@dataclass
+class Portal:
+    prepared: Prepared
+    params: List[Any]
+    result_formats: List[int]
+
+
+@dataclass
+class TxState:
+    """Explicit-transaction bookkeeping for one connection."""
+
+    active: bool = False
+    failed: bool = False
+    writes: List[Tuple[str, Tuple]] = field(default_factory=list)
+
+    @property
+    def status(self) -> bytes:
+        if not self.active:
+            return b"I"
+        return b"E" if self.failed else b"T"
+
+
+class PgServer:
+    """PostgreSQL endpoint bound to one agent (ref: corro_pg::start,
+    wired in run_root.rs:67-74)."""
+
+    def __init__(
+        self,
+        agent: Agent,
+        broadcast_hook=None,
+        subs=None,
+    ) -> None:
+        self.agent = agent
+        self.broadcast_hook = broadcast_hook
+        self.subs = subs
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: set = set()
+        self.port: Optional[int] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # close live sessions first: 3.12+ wait_closed() waits for the
+            # handlers, which otherwise block in readexactly() forever
+            for w in list(self._writers):
+                w.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- connection --------------------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        out = MessageWriter(writer)
+        self._writers.add(writer)
+        try:
+            if not await self._startup(reader, writer, out):
+                return
+            await self._session(reader, writer, out)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            BrokenPipeError,
+        ):
+            pass
+        except Exception:
+            logger.exception("pg connection crashed")
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
+
+    async def _startup(self, reader, writer, out: MessageWriter) -> bool:
+        while True:
+            header = await reader.readexactly(8)
+            length, code = struct.unpack("!II", header)
+            if code == SSL_REQUEST_CODE or code == GSSENC_REQUEST_CODE:
+                writer.write(b"N")  # no TLS on this listener
+                await writer.drain()
+                continue
+            if code == CANCEL_REQUEST_CODE:
+                await reader.read(length - 8)
+                return False
+            if code != PROTOCOL_VERSION:
+                out.error(f"unsupported protocol version {code}", "08P01")
+                await writer.drain()
+                return False
+            params_raw = await reader.readexactly(length - 8)
+            break
+        # parse startup parameters (ignored beyond logging)
+        params: Dict[str, str] = {}
+        parts = params_raw.split(b"\x00")
+        for k, v in zip(parts[::2], parts[1::2]):
+            if k:
+                params[k.decode()] = v.decode()
+        logger.debug("pg startup: %s", params)
+        out.auth_ok()
+        for key, value in (
+            ("server_version", "14.0 (corrosion-tpu)"),
+            ("server_encoding", "UTF8"),
+            ("client_encoding", params.get("client_encoding", "UTF8")),
+            ("DateStyle", "ISO, MDY"),
+            ("integer_datetimes", "on"),
+            ("standard_conforming_strings", "on"),
+        ):
+            out.parameter_status(key, value)
+        out.backend_key_data(secrets.randbits(31), secrets.randbits(31))
+        out.ready(b"I")
+        await writer.drain()
+        return True
+
+    async def _session(self, reader, writer, out: MessageWriter) -> None:
+        tx = TxState()
+        prepared: Dict[str, Prepared] = {}
+        portals: Dict[str, Portal] = {}
+        # after an extended-protocol error, the protocol requires
+        # discarding messages until Sync (one ErrorResponse per batch)
+        skip_until_sync = False
+        while True:
+            kind = await reader.readexactly(1)
+            (length,) = struct.unpack("!I", await reader.readexactly(4))
+            payload = await reader.readexactly(length - 4)
+            if kind == b"X":  # Terminate
+                return
+            if skip_until_sync and kind not in (b"S", b"Q"):
+                continue
+            if kind == b"Q":
+                skip_until_sync = False
+                await self._simple_query(payload, out, tx)
+                out.ready(tx.status)
+                await writer.drain()
+            elif kind == b"P":
+                ok = await self._parse(payload, out, prepared)
+                skip_until_sync = not ok
+            elif kind == b"B":
+                ok = await self._bind(payload, out, prepared, portals)
+                skip_until_sync = not ok
+            elif kind == b"D":
+                ok = await self._describe(payload, out, prepared, portals)
+                skip_until_sync = not ok
+            elif kind == b"E":
+                ok = await self._execute(payload, out, tx, portals)
+                skip_until_sync = not ok
+            elif kind == b"C":  # Close statement/portal
+                target, name = payload[0:1], payload[1:-1].decode()
+                if target == b"S":
+                    prepared.pop(name, None)
+                else:
+                    portals.pop(name, None)
+                out.close_complete()
+            elif kind == b"S":  # Sync
+                skip_until_sync = False
+                out.ready(tx.status)
+                await writer.drain()
+            elif kind == b"H":  # Flush
+                await writer.drain()
+            else:
+                out.error(f"unsupported message {kind!r}", "0A000")
+                skip_until_sync = True
+                await writer.drain()
+
+    # -- statement execution ----------------------------------------------
+
+    async def _simple_query(
+        self, payload: bytes, out: MessageWriter, tx: TxState
+    ) -> None:
+        script = payload[:-1].decode()
+        statements = split_statements(script)
+        if not statements:
+            out.empty_query()
+            return
+        # a multi-statement simple-query message is one implicit
+        # transaction in PG: nothing before a failing statement persists
+        implicit = not tx.active and len(statements) > 1
+        if implicit:
+            tx.active, tx.failed = True, False
+            tx.writes.clear()
+        failed = False
+        for raw in statements:
+            try:
+                await self._run_statement(
+                    raw, (), out, tx, describe_rows=True
+                )
+            except Exception as e:
+                if tx.active:
+                    tx.failed = True
+                failed = True
+                out.error(str(e))
+                break  # simple protocol aborts the script on error
+        if implicit and tx.active:
+            # close our implicit block (an explicit COMMIT/ROLLBACK in the
+            # script would have deactivated it already)
+            writes, tx.writes = list(tx.writes), []
+            tx.active = tx.failed = False
+            if not failed and writes:
+                await self._apply_writes(writes)
+
+    async def _run_statement(
+        self,
+        raw_sql: str,
+        params: Tuple,
+        out: MessageWriter,
+        tx: TxState,
+        describe_rows: bool,
+    ) -> None:
+        kind = classify(raw_sql)
+        sql = translate_sql(raw_sql)
+        if tx.active and tx.failed and kind not in ("commit", "rollback"):
+            raise PgProtocolError(
+                "current transaction is aborted, commands ignored until "
+                "end of transaction block"
+            )
+        if kind == "begin":
+            tx.active, tx.failed = True, False
+            tx.writes.clear()
+            out.command_complete("BEGIN")
+        elif kind == "rollback":
+            tx.active, tx.failed = False, False
+            tx.writes.clear()
+            out.command_complete("ROLLBACK")
+        elif kind == "commit":
+            writes, tx.writes = list(tx.writes), []
+            was_failed, tx.active, tx.failed = tx.failed, False, False
+            if was_failed:
+                out.command_complete("ROLLBACK")
+            else:
+                if writes:
+                    await self._apply_writes(writes)
+                out.command_complete("COMMIT")
+        elif kind == "set":
+            out.command_complete(raw_sql.split(None, 1)[0].upper())
+        elif kind == "show":
+            # SHOW shim: canned session parameters (clients issue these at
+            # connect; SQLAlchemy needs standard_conforming_strings)
+            param = (raw_sql.split(None, 1)[1:] or [""])[0].strip().strip(";")
+            value = {
+                "server_version": "14.0 (corrosion-tpu)",
+                "standard_conforming_strings": "on",
+                "client_encoding": "UTF8",
+                "server_encoding": "UTF8",
+                "integer_datetimes": "on",
+                "transaction isolation level": "serializable",
+                "datestyle": "ISO, MDY",
+            }.get(param.lower(), "")
+            if describe_rows:
+                out.row_description([(param or "parameter", OID_TEXT)])
+            out.data_row([value])
+            out.command_complete("SHOW")
+        elif kind == "read":
+            await self._run_read(sql, raw_sql, params, out, describe_rows)
+        else:  # write
+            if tx.active:
+                # buffered until COMMIT: one corrosion version per tx
+                tx.writes.append((sql, params))
+                out.command_complete(command_tag(raw_sql, 0))
+            else:
+                outcome = await self._apply_writes([(sql, params)])
+                rows = outcome.results[0].rows_affected if outcome.results else 0
+                out.command_complete(command_tag(raw_sql, rows))
+
+    async def _run_read(
+        self,
+        sql: str,
+        raw_sql: str,
+        params: Tuple,
+        out: MessageWriter,
+        describe_rows: bool,
+    ) -> None:
+        if _PG_CATALOG_RE.search(sql):
+            # pg_catalog shim: empty result (the reference implements
+            # real vtabs; clients mostly tolerate empty introspection)
+            if describe_rows:
+                out.row_description([("?column?", OID_TEXT)])
+            out.command_complete("SELECT 0")
+            return
+        if re.fullmatch(r"\s*select\s+version\s*\(\s*\)\s*;?\s*", sql, re.I):
+            if describe_rows:
+                out.row_description([("version", OID_TEXT)])
+            out.data_row(["PostgreSQL 14.0 (corrosion-tpu)"])
+            out.command_complete("SELECT 1")
+            return
+
+        def _read(conn):
+            cur = conn.execute(sql, params)
+            desc = [d[0] for d in cur.description] if cur.description else []
+            return desc, cur.fetchall()
+
+        desc, rows = await self.agent.pool.read_call(_read)
+        if describe_rows:
+            out.row_description(self._column_oids(desc, rows))
+        for row in rows:
+            out.data_row(row)
+        out.command_complete(command_tag(raw_sql, len(rows)))
+
+    @staticmethod
+    def _column_oids(
+        desc: List[str], rows: List[Sequence[Any]]
+    ) -> List[Tuple[str, int]]:
+        oids: List[int] = []
+        for idx, name in enumerate(desc):
+            oid = OID_TEXT
+            for row in rows:
+                if row[idx] is not None:
+                    oid = _infer_oid(row[idx])
+                    break
+            oids.append(oid)
+        return list(zip(desc, oids))
+
+    async def _apply_writes(self, writes: List[Tuple[str, Tuple]]):
+        """Writes go through the same version/broadcast path as HTTP
+        (ref: corro-pg importing the broadcast plumbing, lib.rs:16-23)."""
+        outcome = await make_broadcastable_changes(self.agent, writes)
+        if outcome.changesets:
+            if self.broadcast_hook is not None:
+                await self.broadcast_hook(outcome.changesets)
+            if self.subs is not None:
+                self.subs.match_changes(
+                    [(c.actor_id, c.changeset) for c in outcome.changesets]
+                )
+        return outcome
+
+    # -- extended protocol -------------------------------------------------
+
+    async def _parse(
+        self, payload: bytes, out: MessageWriter, prepared: Dict[str, Prepared]
+    ) -> bool:
+        name_end = payload.index(b"\x00")
+        name = payload[:name_end].decode()
+        rest = payload[name_end + 1 :]
+        sql_end = rest.index(b"\x00")
+        raw_sql = rest[:sql_end].decode()
+        rest = rest[sql_end + 1 :]
+        (n_oids,) = struct.unpack("!H", rest[:2])
+        oids = [
+            struct.unpack("!I", rest[2 + i * 4 : 6 + i * 4])[0]
+            for i in range(n_oids)
+        ]
+        n_params = len(set(_PARAM_RE.findall(raw_sql)))
+        while len(oids) < n_params:
+            oids.append(OID_TEXT)
+        prepared[name] = Prepared(
+            sql=translate_sql(raw_sql), raw_sql=raw_sql, param_oids=oids
+        )
+        out.parse_complete()
+        return True
+
+    async def _bind(
+        self,
+        payload: bytes,
+        out: MessageWriter,
+        prepared: Dict[str, Prepared],
+        portals: Dict[str, Portal],
+    ) -> bool:
+        off = payload.index(b"\x00")
+        portal_name = payload[:off].decode()
+        rest = payload[off + 1 :]
+        off = rest.index(b"\x00")
+        stmt_name = rest[:off].decode()
+        rest = rest[off + 1 :]
+        stmt = prepared.get(stmt_name)
+        if stmt is None:
+            out.error(f"unknown prepared statement {stmt_name!r}", "26000")
+            return False
+        (n_fmt,) = struct.unpack("!H", rest[:2])
+        rest = rest[2:]
+        fmts = [
+            struct.unpack("!H", rest[i * 2 : i * 2 + 2])[0]
+            for i in range(n_fmt)
+        ]
+        rest = rest[n_fmt * 2 :]
+        (n_params,) = struct.unpack("!H", rest[:2])
+        rest = rest[2:]
+        params: List[Any] = []
+        for i in range(n_params):
+            (plen,) = struct.unpack("!i", rest[:4])
+            rest = rest[4:]
+            if plen == -1:
+                data = None
+            else:
+                data, rest = rest[:plen], rest[plen:]
+            fmt = fmts[i] if i < len(fmts) else (fmts[0] if len(fmts) == 1 else 0)
+            oid = (
+                stmt.param_oids[i]
+                if i < len(stmt.param_oids)
+                else OID_TEXT
+            )
+            params.append(_decode_param(data, fmt, oid))
+        (n_rfmt,) = struct.unpack("!H", rest[:2])
+        rest = rest[2:]
+        rfmts = [
+            struct.unpack("!H", rest[i * 2 : i * 2 + 2])[0]
+            for i in range(n_rfmt)
+        ]
+        if any(f == 1 for f in rfmts):
+            out.error("binary result format is not supported", "0A000")
+            return False
+        portals[portal_name] = Portal(
+            prepared=stmt, params=params, result_formats=rfmts
+        )
+        out.bind_complete()
+        return True
+
+    async def _describe(
+        self,
+        payload: bytes,
+        out: MessageWriter,
+        prepared: Dict[str, Prepared],
+        portals: Dict[str, Portal],
+    ) -> bool:
+        target, name = payload[0:1], payload[1:-1].decode()
+        if target == b"S":
+            stmt = prepared.get(name)
+            if stmt is None:
+                out.error(f"unknown prepared statement {name!r}", "26000")
+                return False
+            out.parameter_description(stmt.param_oids)
+            await self._describe_rows(stmt, None, out)
+        else:
+            portal = portals.get(name)
+            if portal is None:
+                out.error(f"unknown portal {name!r}", "34000")
+                return False
+            await self._describe_rows(portal.prepared, portal.params, out)
+        return True
+
+    async def _describe_rows(
+        self,
+        stmt: Prepared,
+        params: Optional[List[Any]],
+        out: MessageWriter,
+    ) -> None:
+        if classify(stmt.raw_sql) != "read":
+            out.no_data()
+            return
+
+        n = len(stmt.param_oids)
+        bound = tuple(params) if params is not None else tuple([None] * n)
+
+        def _describe(conn):
+            # LIMIT 0 probe: column names without materializing rows
+            cur = conn.execute(
+                f"SELECT * FROM ({stmt.sql.rstrip(';')}) LIMIT 0", bound
+            )
+            return [d[0] for d in cur.description] if cur.description else []
+
+        try:
+            desc = await self.agent.pool.read_call(_describe)
+        except Exception:
+            out.no_data()
+            return
+        out.row_description([(name, OID_TEXT) for name in desc])
+
+    async def _execute(
+        self,
+        payload: bytes,
+        out: MessageWriter,
+        tx: TxState,
+        portals: Dict[str, Portal],
+    ) -> bool:
+        name = payload[: payload.index(b"\x00")].decode()
+        portal = portals.get(name)
+        if portal is None:
+            out.error(f"unknown portal {name!r}", "34000")
+            return False
+        try:
+            await self._run_statement(
+                portal.prepared.raw_sql,
+                tuple(portal.params),
+                out,
+                tx,
+                describe_rows=False,
+            )
+        except Exception as e:
+            if tx.active:
+                tx.failed = True
+            out.error(str(e))
+            return False
+        return True

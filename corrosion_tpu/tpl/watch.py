@@ -142,10 +142,19 @@ class TemplateWatcher:
                 self._wake.set()
                 continue
             except ClientError as e:
-                # the server rejected the query (not subscribable):
-                # permanent — fall back to the mtime poll only
-                logger.warning("template sub for %r rejected: %s", sql_text, e)
-                return
+                if e.status is not None and 400 <= e.status < 500:
+                    # the server rejected the query (not subscribable):
+                    # permanent — fall back to the mtime poll only
+                    logger.warning(
+                        "template sub for %r rejected: %s", sql_text, e
+                    )
+                    return
+                # 5xx / stream errors are transient server trouble
+                logger.warning(
+                    "template sub for %r failed (%s); retrying", sql_text, e
+                )
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 15.0)
             except Exception as e:
                 logger.warning(
                     "template sub for %r failed (%s); retrying", sql_text, e

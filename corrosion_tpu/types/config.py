@@ -40,6 +40,9 @@ class DbConfig:
 class ApiConfig:
     addr: str = "127.0.0.1:0"
     authz_bearer: Optional[str] = None
+    # optional PostgreSQL wire-protocol listener (ref: config.rs pg addr,
+    # wired in run_root.rs:67-74)
+    pg_addr: Optional[str] = None
 
 
 @dataclass

@@ -1,0 +1,401 @@
+"""PG wire-protocol server tests (ref: crates/corro-pg/ — v3 protocol,
+extended query protocol, writes through the broadcast path).
+
+No PostgreSQL client library is available in this environment, so the
+tests drive the server with a minimal hand-rolled v3 protocol client.
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from corrosion_tpu.agent import Agent, AgentConfig
+from corrosion_tpu.pg import PgServer, split_statements, translate_sql
+from corrosion_tpu.types.schema import apply_schema
+
+SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, "
+    'text TEXT NOT NULL DEFAULT "") WITHOUT ROWID'
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class MiniPg:
+    """A minimal PostgreSQL v3 front-end for testing."""
+
+    def __init__(self, port: int) -> None:
+        self.port = port
+        self.reader = None
+        self.writer = None
+        self.params = {}
+
+    async def connect(self) -> "MiniPg":
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port
+        )
+        body = struct.pack("!I", 196608)
+        body += b"user\x00tester\x00database\x00corrosion\x00\x00"
+        self.writer.write(struct.pack("!I", len(body) + 4) + body)
+        await self.writer.drain()
+        # read until ReadyForQuery
+        while True:
+            kind, payload = await self.read_message()
+            if kind == b"S":
+                key, value = payload.rstrip(b"\x00").split(b"\x00")
+                self.params[key.decode()] = value.decode()
+            elif kind == b"Z":
+                assert payload == b"I"
+                return self
+            elif kind == b"E":
+                raise AssertionError(f"startup error: {payload}")
+
+    async def read_message(self):
+        kind = await self.reader.readexactly(1)
+        (length,) = struct.unpack("!I", await self.reader.readexactly(4))
+        payload = await self.reader.readexactly(length - 4)
+        return kind, payload
+
+    def send(self, kind: bytes, payload: bytes = b"") -> None:
+        self.writer.write(kind + struct.pack("!I", len(payload) + 4) + payload)
+
+    async def collect_until_ready(self):
+        """Gather messages until ReadyForQuery; returns (events, status)."""
+        events = []
+        while True:
+            kind, payload = await self.read_message()
+            if kind == b"Z":
+                return events, payload
+            events.append((kind, payload))
+
+    async def query(self, sql: str):
+        """Simple query; returns (columns, rows, tags, errors, status)."""
+        self.send(b"Q", sql.encode() + b"\x00")
+        await self.writer.drain()
+        events, status = await self.collect_until_ready()
+        return self._digest(events) + (status,)
+
+    @staticmethod
+    def _digest(events):
+        columns, rows, tags, errors = [], [], [], []
+        for kind, payload in events:
+            if kind == b"T":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                cols = []
+                for _ in range(n):
+                    end = payload.index(b"\x00", off)
+                    name = payload[off:end].decode()
+                    off = end + 1 + 18
+                    cols.append(name)
+                columns = cols
+            elif kind == b"D":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                cells = []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", payload[off : off + 4])
+                    off += 4
+                    if ln == -1:
+                        cells.append(None)
+                    else:
+                        cells.append(payload[off : off + ln].decode())
+                        off += ln
+                rows.append(cells)
+            elif kind == b"C":
+                tags.append(payload[:-1].decode())
+            elif kind == b"E":
+                fields = {}
+                for part in payload.split(b"\x00"):
+                    if part:
+                        fields[chr(part[0])] = part[1:].decode()
+                errors.append(fields)
+        return columns, rows, tags, errors
+
+    async def close(self):
+        self.send(b"X")
+        await self.writer.drain()
+        self.writer.close()
+
+    # extended protocol helpers
+
+    async def extended(self, sql: str, params=(), stmt="", portal=""):
+        """Parse+Bind+Describe+Execute+Sync round trip."""
+        self.send(
+            b"P",
+            stmt.encode() + b"\x00" + sql.encode() + b"\x00"
+            + struct.pack("!H", 0),
+        )
+        bind = portal.encode() + b"\x00" + stmt.encode() + b"\x00"
+        bind += struct.pack("!H", 1) + struct.pack("!H", 0)  # all-text params
+        bind += struct.pack("!H", len(params))
+        for p in params:
+            if p is None:
+                bind += struct.pack("!i", -1)
+            else:
+                data = str(p).encode()
+                bind += struct.pack("!i", len(data)) + data
+        bind += struct.pack("!H", 0)  # default (text) result format
+        self.send(b"B", bind)
+        self.send(b"D", b"P" + portal.encode() + b"\x00")
+        self.send(b"E", portal.encode() + b"\x00" + struct.pack("!i", 0))
+        self.send(b"S")
+        await self.writer.drain()
+        events, status = await self.collect_until_ready()
+        return self._digest(events) + (status,)
+
+
+async def boot():
+    agent = Agent(AgentConfig(db_path=":memory:", read_conns=2)).open_sync()
+    await agent.pool.write_call(lambda c: apply_schema(c, SCHEMA))
+    broadcasts = []
+
+    async def hook(changes):
+        broadcasts.extend(changes)
+
+    server = PgServer(agent, broadcast_hook=hook)
+    port = await server.start()
+    return agent, server, port, broadcasts
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_translate_and_split():
+    assert translate_sql("SELECT * FROM t WHERE id = $1") == (
+        "SELECT * FROM t WHERE id = ?1"
+    )
+    assert translate_sql("SELECT 1::bigint") == "SELECT 1"
+    # cast stripping must not eat the rest of the query
+    assert translate_sql("SELECT id::text FROM tests WHERE x = 1") == (
+        "SELECT id FROM tests WHERE x = 1"
+    )
+    assert translate_sql("SELECT x::double precision, y::varchar(10)") == (
+        "SELECT x, y"
+    )
+    assert split_statements("SELECT 1; SELECT 'a;b'; ") == [
+        "SELECT 1",
+        "SELECT 'a;b'",
+    ]
+
+
+def test_classify_with_cte():
+    from corrosion_tpu.pg import classify
+
+    assert classify("WITH x AS (SELECT 1) SELECT * FROM x") == "read"
+    assert (
+        classify("WITH new AS (VALUES (1)) INSERT INTO t SELECT * FROM new")
+        == "write"
+    )
+    assert classify("SHOW standard_conforming_strings") == "show"
+
+
+def test_startup_and_simple_query():
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+        assert "corrosion-tpu" in pg.params["server_version"]
+
+        cols, rows, tags, errors, status = await pg.query("SELECT 1 + 1")
+        assert not errors
+        assert rows == [["2"]]
+        assert tags == ["SELECT 1"]
+        assert status == b"I"
+
+        cols, rows, tags, errors, _ = await pg.query("SELECT version()")
+        assert "corrosion-tpu" in rows[0][0]
+
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_writes_allocate_versions_and_broadcast():
+    async def main():
+        agent, server, port, broadcasts = await boot()
+        pg = await MiniPg(port).connect()
+
+        _, _, tags, errors, _ = await pg.query(
+            "INSERT INTO tests (id, text) VALUES (1, 'from-psql')"
+        )
+        assert not errors
+        assert tags == ["INSERT 0 1"]
+
+        # the write allocated a corrosion version and produced a broadcast
+        assert agent.generate_sync().heads[agent.actor_id] == 1
+        assert len(broadcasts) == 1
+
+        cols, rows, _, _, _ = await pg.query("SELECT id, text FROM tests")
+        assert cols == ["id", "text"]
+        assert rows == [["1", "from-psql"]]
+
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_multi_statement_script_is_one_implicit_transaction():
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+
+        _, rows, tags, errors, _ = await pg.query(
+            "INSERT INTO tests (id, text) VALUES (10, 'a'); SELECT COUNT(*) FROM tests"
+        )
+        assert not errors
+        # the write is buffered until the script commits, so the in-script
+        # read sees the pre-script snapshot (documented divergence)
+        assert tags == ["INSERT 0 0", "SELECT 1"]
+        assert rows == [["0"]]
+        _, rows, _, _, _ = await pg.query("SELECT COUNT(*) FROM tests")
+        assert rows == [["1"]]  # …but it landed at script end
+
+        # an error rolls back everything in the script (PG implicit-tx
+        # semantics): the INSERT before the failure must NOT persist
+        _, _, tags, errors, status = await pg.query(
+            "INSERT INTO tests (id, text) VALUES (11, 'x'); SELECT nope FROM missing"
+        )
+        assert errors and "no such table" in errors[0]["M"]
+        assert status == b"I"
+        _, rows, _, _, _ = await pg.query("SELECT COUNT(*) FROM tests")
+        assert rows == [["1"]]  # id=11 rolled back with the script
+
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_transaction_buffering_and_rollback():
+    async def main():
+        agent, server, port, broadcasts = await boot()
+        pg = await MiniPg(port).connect()
+
+        _, _, tags, _, status = await pg.query("BEGIN")
+        assert tags == ["BEGIN"] and status == b"T"
+        await pg.query("INSERT INTO tests (id, text) VALUES (1, 'tx1')")
+        await pg.query("INSERT INTO tests (id, text) VALUES (2, 'tx2')")
+        assert broadcasts == []  # nothing applied yet
+        _, _, tags, _, status = await pg.query("COMMIT")
+        assert tags == ["COMMIT"] and status == b"I"
+
+        # both inserts landed as ONE corrosion version
+        assert agent.generate_sync().heads[agent.actor_id] == 1
+        _, rows, _, _, _ = await pg.query("SELECT COUNT(*) FROM tests")
+        assert rows == [["2"]]
+
+        # rollback discards
+        await pg.query("BEGIN")
+        await pg.query("INSERT INTO tests (id, text) VALUES (3, 'nope')")
+        await pg.query("ROLLBACK")
+        _, rows, _, _, _ = await pg.query("SELECT COUNT(*) FROM tests")
+        assert rows == [["2"]]
+
+        # a failed statement poisons the tx until rollback/commit
+        await pg.query("BEGIN")
+        _, _, _, errors, status = await pg.query("SELECT bad FROM nowhere")
+        assert errors and status == b"E"
+        _, _, _, errors, _ = await pg.query(
+            "INSERT INTO tests (id, text) VALUES (4, 'x')"
+        )
+        assert errors and "aborted" in errors[0]["M"]
+        _, _, tags, _, status = await pg.query("COMMIT")
+        assert tags == ["ROLLBACK"] and status == b"I"
+
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_extended_protocol_with_params():
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+
+        _, _, tags, errors, _ = await pg.extended(
+            "INSERT INTO tests (id, text) VALUES ($1, $2)", params=(5, "ext")
+        )
+        assert not errors
+        assert tags == ["INSERT 0 1"]
+
+        cols, rows, tags, errors, _ = await pg.extended(
+            "SELECT text FROM tests WHERE id = $1", params=(5,)
+        )
+        assert not errors
+        assert cols == ["text"]  # Describe produced a RowDescription
+        assert rows == [["ext"]]
+        assert tags == ["SELECT 1"]
+
+        # unknown portal errors cleanly
+        pg.send(b"E", b"ghost\x00" + struct.pack("!i", 0))
+        pg.send(b"S")
+        await pg.writer.drain()
+        events, _ = await pg.collect_until_ready()
+        assert any(k == b"E" for k, _ in events)
+
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_set_show_and_pg_catalog_shims():
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+
+        _, _, tags, errors, _ = await pg.query("SET client_min_messages TO warning")
+        assert not errors and tags == ["SET"]
+
+        _, rows, tags, errors, _ = await pg.query(
+            "SHOW standard_conforming_strings"
+        )
+        assert not errors and tags == ["SHOW"] and rows == [["on"]]
+
+        _, rows, tags, errors, _ = await pg.query(
+            "SELECT oid, typname FROM pg_catalog.pg_type"
+        )
+        assert not errors and rows == [] and tags == ["SELECT 0"]
+
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_node_config_starts_pg(tmp_path):
+    from corrosion_tpu.agent.node import Node
+    from corrosion_tpu.harness import free_port
+    from corrosion_tpu.types.config import Config
+
+    async def main():
+        port = free_port()
+        cfg = Config()
+        cfg.db.path = ":memory:"
+        cfg.api.pg_addr = f"127.0.0.1:{port}"
+        node = await Node(cfg).start()
+        try:
+            from corrosion_tpu.types.schema import apply_schema as apply
+
+            await node.agent.pool.write_call(lambda c: apply(c, SCHEMA))
+            pg = await MiniPg(port).connect()
+            await pg.query("INSERT INTO tests (id, text) VALUES (9, 'node')")
+            _, rows, _, _, _ = await pg.query("SELECT text FROM tests")
+            assert rows == [["node"]]
+            await pg.close()
+        finally:
+            await node.stop()
+
+    run(main())

@@ -111,6 +111,9 @@ class ChangeIngest:
                 to_rebroadcast.append(change)
         if not to_apply:
             return
+        from ..utils.metrics import counter, histogram
+        from ..types.clock import ntp64_to_unix_ns
+
         try:
             result = await self.agent.process_multiple_changes(to_apply)
         except Exception:
@@ -119,6 +122,20 @@ class ChangeIngest:
             for change, _ in batch:
                 self._seen.pop(self._seen_key(change), None)
             raise
+        # count only after a successful apply — failed batches retry and
+        # must not inflate the series (ref: handlers.rs:517-519 lag hist)
+        counter("corro.changes.applied").inc(
+            sum(len(getattr(c.changeset, "changes", ())) for c in to_apply)
+        )
+        counter("corro.changes.batches").inc()
+        now_ns = ntp64_to_unix_ns(self.agent.clock.new_timestamp())
+        for c in to_apply:
+            ts = getattr(c.changeset, "ts", None)
+            if isinstance(ts, str) and ts.isdigit():
+                ts = int(ts)  # large u64s ride the wire as strings
+            if isinstance(ts, int) and ts > 0:
+                lag = max(0.0, (now_ns - ntp64_to_unix_ns(ts)) / 1e9)
+                histogram("corro.changes.lag.seconds").observe(lag)
         if self.rebroadcast is not None and to_rebroadcast:
             await self.rebroadcast(to_rebroadcast)
         if self.notify is not None and result.applied:

@@ -61,6 +61,8 @@ class Node:
         self.subs: Optional[SubsManager] = None
         self.admin = None  # AdminServer when config.admin.uds_path is set
         self.pg = None  # PgServer when config.api.pg_addr is set
+        self._prom_runner = None  # prometheus exporter AppRunner
+        self.prometheus_port: Optional[int] = None
         self._tasks: List[asyncio.Task] = []
         self._subs_tmpdir = None  # TemporaryDirectory for :memory: nodes
         self._started = False
@@ -155,12 +157,36 @@ class Node:
             )
             await self.pg.start(pg_host, pg_port)
 
+        if self.config.telemetry.prometheus_addr:
+            from ..utils.metrics import render_prometheus
+            from aiohttp import web as aioweb
+
+            prom_host, prom_port = parse_addr(
+                self.config.telemetry.prometheus_addr
+            )
+            app = aioweb.Application()
+            app.router.add_get(
+                "/metrics",
+                lambda r: aioweb.Response(
+                    text=render_prometheus(),
+                    content_type="text/plain",
+                ),
+            )
+            self._prom_runner = aioweb.AppRunner(app)
+            await self._prom_runner.setup()
+            site = aioweb.TCPSite(self._prom_runner, prom_host, prom_port)
+            await site.start()
+            self.prometheus_port = site._server.sockets[0].getsockname()[1]
+
         self.broadcast.start()
         self.ingest.start()
         self._tasks.append(asyncio.create_task(self._swim_loop()))
         self._tasks.append(asyncio.create_task(self._sync_loop()))
         self._tasks.append(asyncio.create_task(self._persist_members_loop()))
         self._tasks.append(asyncio.create_task(self._announce_loop()))
+        if self.config.telemetry.prometheus_addr:
+            # gauges nothing will scrape aren't worth COUNT(*) scans
+            self._tasks.append(asyncio.create_task(self._metrics_loop()))
         self._started = True
         return self
 
@@ -188,6 +214,9 @@ class Node:
         if self.pg is not None:
             await self.pg.stop()
             self.pg = None
+        if self._prom_runner is not None:
+            await self._prom_runner.cleanup()
+            self._prom_runner = None
         if self.api is not None:
             await self.api.stop()
         if self.transport is not None:
@@ -298,6 +327,63 @@ class Node:
                 raise
 
         await self.agent.pool.write_call(_write)
+
+    async def _metrics_loop(self) -> None:
+        """Periodic store/cluster gauges (ref: metrics_loop +
+        agent/metrics.rs:18-80: DB/WAL size, per-table row counts).
+
+        Gauges carry an ``actor`` label: the registry is process-global,
+        and an in-process dev cluster would otherwise last-writer-win
+        across nodes."""
+        import os
+
+        from ..utils.metrics import gauge
+
+        me = self.agent.actor_id.as_simple()[:8]
+        while True:
+            await asyncio.sleep(10.0)
+            try:
+                if self.members is not None:
+                    states = self.members.states.values()
+                    gauge("corro.members.up", actor=me).set(
+                        sum(1 for m in states if m.state == "up")
+                    )
+                    gauge("corro.members.total", actor=me).set(
+                        len(self.members.states)
+                    )
+                db_path = self.config.db.path
+                if db_path != ":memory:" and os.path.exists(db_path):
+                    gauge("corro.db.size.bytes", actor=me).set(
+                        os.path.getsize(db_path)
+                    )
+                    wal = db_path + "-wal"
+                    if os.path.exists(wal):
+                        gauge("corro.db.wal.size.bytes", actor=me).set(
+                            os.path.getsize(wal)
+                        )
+
+                def _table_counts(conn):
+                    tables = [
+                        r[0]
+                        for r in conn.execute(
+                            "SELECT name FROM sqlite_master WHERE type = "
+                            "'table' AND name NOT LIKE '__corro%' AND name "
+                            "NOT LIKE '%__crsql_%' AND name NOT LIKE "
+                            "'sqlite_%' AND name NOT LIKE 'crsql_%'"
+                        ).fetchall()
+                    ]
+                    return {
+                        t: conn.execute(
+                            f'SELECT COUNT(*) FROM "{t}"'
+                        ).fetchone()[0]
+                        for t in tables
+                    }
+
+                counts = await self.agent.pool.read_call(_table_counts)
+                for table, n in counts.items():
+                    gauge("corro.db.table.rows", table=table, actor=me).set(n)
+            except Exception:
+                logger.debug("metrics loop tick failed", exc_info=True)
 
     async def _notify_subs(self, applied) -> None:
         """Remote-apply subscription notify (ref: util.rs:1380-1384)."""

@@ -92,9 +92,12 @@ class BroadcastRuntime:
         )
         self.rng.shuffle(others)
         targets = ring0 + others[:n_random]
+        from ..utils.metrics import counter
+
         for member in targets:
             with contextlib.suppress(OSError, ConnectionError):
                 await self.transport.send_uni(member.addr, payload)
+                counter("corro.broadcast.sent").inc()
         if others[n_random:]:
             self.pending.append(PendingBroadcast(payload=payload, send_count=1))
 
@@ -106,11 +109,14 @@ class BroadcastRuntime:
             ups = self.members.up_members()
             if not ups:
                 continue
+            from ..utils.metrics import counter
+
             for pb in list(self.pending):
                 sample = self.rng.sample(ups, min(NUM_INDIRECT_PROBES, len(ups)))
                 for member in sample:
                     with contextlib.suppress(OSError, ConnectionError):
                         await self.transport.send_uni(member.addr, pb.payload)
+                        counter("corro.broadcast.resent").inc()
                 pb.send_count += 1
                 if pb.send_count >= self.max_transmissions:
                     self.pending.remove(pb)

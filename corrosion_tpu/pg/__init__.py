@@ -89,11 +89,51 @@ _PG_CATALOG_RE = re.compile(
 
 
 def translate_sql(sql: str) -> str:
-    """PG dialect → SQLite: ``$N`` params and ``::cast`` stripping
+    """PG dialect → SQLite: ``$N`` params and ``::cast`` stripping,
+    applied only OUTSIDE string literals so data is never rewritten
     (ref: corro-pg's sqlparser translation pass)."""
-    sql = _PARAM_RE.sub(lambda m: f"?{m.group(1)}", sql)
-    sql = _CAST_RE.sub("", sql)
-    return sql
+    out: List[str] = []
+    for segment, quoted in _segments(sql):
+        if quoted:
+            out.append(segment)
+        else:
+            segment = _PARAM_RE.sub(lambda m: f"?{m.group(1)}", segment)
+            segment = _CAST_RE.sub("", segment)
+            out.append(segment)
+    return "".join(out)
+
+
+def _segments(sql: str) -> List[Tuple[str, bool]]:
+    """Split SQL into (text, is_quoted) runs; quoted runs include their
+    delimiters and honor '' escaping."""
+    runs: List[Tuple[str, bool]] = []
+    buf: List[str] = []
+    quote: Optional[str] = None
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if quote is None:
+            if ch in ("'", '"'):
+                if buf:
+                    runs.append(("".join(buf), False))
+                buf = [ch]
+                quote = ch
+            else:
+                buf.append(ch)
+        else:
+            buf.append(ch)
+            if ch == quote:
+                if i + 1 < len(sql) and sql[i + 1] == quote:
+                    buf.append(sql[i + 1])
+                    i += 1
+                else:
+                    runs.append(("".join(buf), True))
+                    buf = []
+                    quote = None
+        i += 1
+    if buf:
+        runs.append(("".join(buf), quote is not None))
+    return runs
 
 
 def split_statements(script: str) -> List[str]:
@@ -509,8 +549,18 @@ class PgServer:
             out.empty_query()
             return
         # a multi-statement simple-query message is one implicit
-        # transaction in PG: nothing before a failing statement persists
-        implicit = not tx.active and len(statements) > 1
+        # transaction in PG: nothing before a failing statement persists.
+        # Scripts carrying their own BEGIN/COMMIT/ROLLBACK manage the
+        # transaction explicitly, so the implicit wrapper stays out of
+        # their way (statements outside the explicit block autocommit).
+        implicit = (
+            not tx.active
+            and len(statements) > 1
+            and not any(
+                classify(s) in ("begin", "commit", "rollback")
+                for s in statements
+            )
+        )
         if implicit:
             tx.active, tx.failed = True, False
             tx.writes.clear()
@@ -527,12 +577,15 @@ class PgServer:
                 out.error(str(e))
                 break  # simple protocol aborts the script on error
         if implicit and tx.active:
-            # close our implicit block (an explicit COMMIT/ROLLBACK in the
-            # script would have deactivated it already)
             writes, tx.writes = list(tx.writes), []
             tx.active = tx.failed = False
             if not failed and writes:
-                await self._apply_writes(writes)
+                try:
+                    await self._apply_writes(writes)
+                except Exception as e:
+                    # a commit-time error is a SQL error, not a protocol
+                    # crash: the client gets ErrorResponse + ReadyForQuery
+                    out.error(str(e))
 
     async def _run_statement(
         self,

@@ -32,6 +32,8 @@ from ..types.clock import ClockDriftError
 from ..types.ranges import RangeSet
 from ..types.sync_state import SyncNeedFull, SyncNeedPartial, SyncStateV1
 from ..transport.net import FramedStream, Transport
+from ..utils.metrics import counter, histogram
+from ..utils.tracing import current_traceparent, span
 from .. import wire
 
 MAX_CONCURRENT_SYNCS = 3  # ref: agent.rs:131 sync permit semaphore
@@ -58,13 +60,25 @@ class SyncServer:
         kind, payload = wire.decode_bi(first)
         if kind != "sync_start":
             return
-        peer_actor, peer_cluster, _trace = payload
+        peer_actor, peer_cluster, trace = payload
         if peer_cluster != self.cluster_id:
             await fs.send(wire.encode_sync_rejection("different cluster"))
+            counter("corro.sync.server.rejections", reason="cluster").inc()
             return
         if self._permits.locked():
             await fs.send(wire.encode_sync_rejection("max concurrency reached"))
+            counter("corro.sync.server.rejections", reason="busy").inc()
             return
+        # join the client's trace: its traceparent rides the SyncStart
+        # message (ref: SyncTraceContextV1 extraction, peer.rs:1317-1319)
+        with span(
+            "sync.server",
+            traceparent=(trace or {}).get("traceparent"),
+            peer=peer_actor.as_simple(),
+        ):
+            await self._serve_locked(fs)
+
+    async def _serve_locked(self, fs: FramedStream) -> None:
         async with self._permits:
             # their state + clock
             their_state: Optional[SyncStateV1] = None
@@ -250,6 +264,8 @@ class SyncServer:
                 )
             )
             elapsed = time.monotonic() - t0
+            counter("corro.sync.server.chunks.sent").inc()
+            histogram("corro.sync.server.chunk.send.seconds").observe(elapsed)
             if elapsed > ABORT_SEND_THRESHOLD:
                 raise ConnectionError("sync send too slow, aborting")
             if elapsed > SLOW_SEND_THRESHOLD:
@@ -287,13 +303,29 @@ async def parallel_sync(
     the portion of our needs it can serve that hasn't been claimed by an
     earlier peer this round (ref: req_full/req_partials range sets,
     peer.rs:1117-1120).  Returns changes received."""
+    with span("sync.client", peers=str(len(peers))):
+        return await _parallel_sync_traced(
+            agent, transport, peers, submit, cluster_id
+        )
+
+
+async def _parallel_sync_traced(
+    agent: Agent,
+    transport: Transport,
+    peers: List[Tuple[ActorId, Tuple[str, int]]],
+    submit: Callable[[ChangeV1, str], Awaitable[None]],
+    cluster_id: int,
+) -> int:
     our_state = agent.generate_sync()
 
     async def handshake(actor_id, addr):
         fs = await transport.open_bi(addr)
         try:
+            # inject our trace so the server's spans join it (ref:
+            # traceparent injection at parallel_sync, peer.rs:937-940)
+            trace = {"traceparent": current_traceparent()}
             await fs.send(
-                wire.encode_bi_sync_start(agent.actor_id, cluster_id)
+                wire.encode_bi_sync_start(agent.actor_id, cluster_id, trace)
             )
             await fs.send(wire.encode_sync_state(our_state))
             await fs.send(wire.encode_sync_clock(agent.clock.new_timestamp()))
@@ -380,6 +412,9 @@ async def parallel_sync(
                 kind, payload = wire.decode_sync(data)
                 if kind == "changeset":
                     count += 1
+                    counter("corro.sync.client.changes.recv").inc(
+                        len(getattr(payload.changeset, "changes", ()))
+                    )
                     await submit(payload, ChangeSource.SYNC)
                 elif kind in ("done", "rejection"):
                     break

@@ -1,0 +1,209 @@
+"""Metrics facade + Prometheus text exposition.
+
+Equivalent of the reference's ``metrics`` crate facade + Prometheus HTTP
+exporter (command/agent.rs:105-124; series catalogue in
+doc/telemetry/prometheus.md).  A process-global registry of counters,
+gauges, and histograms with label support; the agent exposes
+``render_prometheus()`` over HTTP when ``telemetry.prometheus_addr`` is
+configured.
+
+Usage::
+
+    counter("corro.broadcast.sent").inc()
+    gauge("corro.members.up").set(5)
+    histogram("corro.changes.lag.seconds").observe(0.12)
+    counter("corro.sync.changes.recv", source="peer1").inc(12)
+
+The registry is process-global (one node per process in production, like
+the reference).  In-process multi-node harnesses share it: per-node
+gauges are disambiguated with an ``actor`` label; unlabeled counters sum
+across the process's nodes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "counter",
+    "gauge",
+    "histogram",
+    "registry",
+    "render_prometheus",
+]
+
+# reference exporter's custom buckets are seconds-scale latencies
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _fmt_labels(key: LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _san(name: str) -> str:
+    return name.replace(".", "_").replace("-", "_")
+
+
+class Counter:
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+
+class Gauge:
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.value -= amount
+
+
+class Histogram:
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        self.buckets = tuple(buckets)
+        self.counts = [0] * len(self.buckets)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self.total += 1
+        self.sum += value
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                self.counts[i] += 1
+
+    def time(self) -> "_Timer":
+        return _Timer(self)
+
+
+class _Timer:
+    def __init__(self, hist: Histogram) -> None:
+        self.hist = hist
+
+    def __enter__(self) -> "_Timer":
+        self.start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.hist.observe(time.monotonic() - self.start)
+
+
+class MetricsRegistry:
+    """Name+labels → metric instance; renders Prometheus text format."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Dict[LabelKey, Counter]] = {}
+        self._gauges: Dict[str, Dict[LabelKey, Gauge]] = {}
+        self._histograms: Dict[str, Dict[LabelKey, Histogram]] = {}
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            key = _label_key(labels)
+            got = series.get(key)
+            if got is None:
+                got = series[key] = Counter()
+            return got
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        with self._lock:
+            series = self._gauges.setdefault(name, {})
+            key = _label_key(labels)
+            got = series.get(key)
+            if got is None:
+                got = series[key] = Gauge()
+            return got
+
+    def histogram(
+        self,
+        name: str,
+        buckets: Optional[Sequence[float]] = None,
+        **labels: str,
+    ) -> Histogram:
+        with self._lock:
+            series = self._histograms.setdefault(name, {})
+            key = _label_key(labels)
+            got = series.get(key)
+            if got is None:
+                got = series[key] = Histogram(buckets or DEFAULT_BUCKETS)
+            return got
+
+    def render_prometheus(self) -> str:
+        out: List[str] = []
+        with self._lock:
+            for name, series in sorted(self._counters.items()):
+                pname = _san(name)
+                out.append(f"# TYPE {pname} counter")
+                for key, c in sorted(series.items()):
+                    out.append(f"{pname}{_fmt_labels(key)} {_num(c.value)}")
+            for name, series in sorted(self._gauges.items()):
+                pname = _san(name)
+                out.append(f"# TYPE {pname} gauge")
+                for key, g in sorted(series.items()):
+                    out.append(f"{pname}{_fmt_labels(key)} {_num(g.value)}")
+            for name, series in sorted(self._histograms.items()):
+                pname = _san(name)
+                out.append(f"# TYPE {pname} histogram")
+                for key, h in sorted(series.items()):
+                    for bound, count in zip(h.buckets, h.counts):
+                        bkey = key + (("le", _num(bound)),)
+                        out.append(
+                            f"{pname}_bucket{_fmt_labels(bkey)} {count}"
+                        )
+                    inf_key = key + (("le", "+Inf"),)
+                    out.append(
+                        f"{pname}_bucket{_fmt_labels(inf_key)} {h.total}"
+                    )
+                    out.append(f"{pname}_sum{_fmt_labels(key)} {_num(h.sum)}")
+                    out.append(f"{pname}_count{_fmt_labels(key)} {h.total}")
+        return "\n".join(out) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+def _num(v: float) -> str:
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+registry = MetricsRegistry()
+counter = registry.counter
+gauge = registry.gauge
+histogram = registry.histogram
+render_prometheus = registry.render_prometheus

@@ -1,0 +1,136 @@
+"""Distributed tracing: W3C trace context + lightweight spans.
+
+Equivalent of the reference's tracing/OpenTelemetry integration, and in
+particular the **cross-node trace propagation over the sync protocol**:
+``SyncTraceContextV1 {traceparent, tracestate}`` rides the
+``BiPayloadV1::SyncStart`` wire message, injected by ``parallel_sync``
+(api/peer.rs:937-940) and extracted by ``serve_sync`` (peer.rs:1317-1319)
+so one sync round's client and server spans stitch into a single trace.
+
+No OTLP exporter exists in this environment; spans are recorded in a
+process-local ring buffer (inspectable in tests/debugging) and logged,
+with ids in W3C ``traceparent`` form (``00-<trace_id>-<span_id>-01``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import secrets
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, Iterator, Optional
+
+logger = logging.getLogger("corrosion_tpu.trace")
+
+__all__ = [
+    "SpanRecord",
+    "TraceContext",
+    "current_traceparent",
+    "recent_spans",
+    "span",
+]
+
+SPAN_BUFFER = 512
+
+
+@dataclass
+class TraceContext:
+    """W3C trace-context ids (traceparent version 00)."""
+
+    trace_id: str  # 32 hex chars
+    span_id: str  # 16 hex chars
+
+    @classmethod
+    def new(cls) -> "TraceContext":
+        return cls(
+            trace_id=secrets.token_hex(16), span_id=secrets.token_hex(8)
+        )
+
+    @classmethod
+    def parse(cls, traceparent: str) -> Optional["TraceContext"]:
+        parts = traceparent.split("-")
+        if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+            return None
+        return cls(trace_id=parts[1], span_id=parts[2])
+
+    @property
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def child(self) -> "TraceContext":
+        return TraceContext(
+            trace_id=self.trace_id, span_id=secrets.token_hex(8)
+        )
+
+
+@dataclass
+class SpanRecord:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start: float
+    duration: float
+    attributes: Dict[str, str] = field(default_factory=dict)
+
+
+_current: contextvars.ContextVar[Optional[TraceContext]] = (
+    contextvars.ContextVar("corro_trace", default=None)
+)
+_spans: Deque[SpanRecord] = deque(maxlen=SPAN_BUFFER)
+
+
+def current_traceparent() -> Optional[str]:
+    """The active span's traceparent, for wire injection."""
+    ctx = _current.get()
+    return ctx.traceparent if ctx is not None else None
+
+
+def recent_spans() -> list:
+    return list(_spans)
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    traceparent: Optional[str] = None,
+    **attributes: str,
+) -> Iterator[TraceContext]:
+    """Open a span.  ``traceparent`` joins a remote trace (the extracted
+    wire field); otherwise the span continues the ambient trace or starts
+    a new one."""
+    parent: Optional[TraceContext] = None
+    if traceparent is not None:
+        parent = TraceContext.parse(traceparent)
+    if parent is None:
+        parent = _current.get()
+    ctx = parent.child() if parent is not None else TraceContext.new()
+    token = _current.set(ctx)
+    start = time.time()
+    t0 = time.monotonic()
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+        duration = time.monotonic() - t0
+        record = SpanRecord(
+            name=name,
+            trace_id=ctx.trace_id,
+            span_id=ctx.span_id,
+            parent_id=parent.span_id if parent is not None else None,
+            start=start,
+            duration=duration,
+            attributes={k: str(v) for k, v in attributes.items()},
+        )
+        _spans.append(record)
+        logger.debug(
+            "span %s trace=%s span=%s dur=%.4fs %s",
+            name,
+            ctx.trace_id,
+            ctx.span_id,
+            duration,
+            attributes,
+        )

@@ -1,0 +1,160 @@
+"""Metrics + tracing tests (ref: the metrics facade/Prometheus exporter,
+command/agent.rs:105-164, and trace propagation over the sync protocol,
+SyncTraceContextV1 in peer.rs:937-940/1317-1319)."""
+
+import asyncio
+
+import pytest
+from aiohttp import ClientSession
+
+from corrosion_tpu.client import CorrosionApiClient
+from corrosion_tpu.harness import free_port
+from corrosion_tpu.utils.metrics import MetricsRegistry
+from corrosion_tpu.utils.tracing import TraceContext, recent_spans, span
+
+SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, "
+    'text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;'
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_render():
+    reg = MetricsRegistry()
+    reg.counter("corro.test.count").inc()
+    reg.counter("corro.test.count").inc(2)
+    reg.counter("corro.test.count", source="sync").inc()
+    reg.gauge("corro.test.gauge").set(7.5)
+    h = reg.histogram("corro.test.lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    text = reg.render_prometheus()
+    assert "# TYPE corro_test_count counter" in text
+    assert "corro_test_count 3" in text
+    assert 'corro_test_count{source="sync"} 1' in text
+    assert "corro_test_gauge 7.5" in text
+    assert 'corro_test_lat_bucket{le="0.1"} 1' in text
+    assert 'corro_test_lat_bucket{le="1"} 2' in text
+    assert 'corro_test_lat_bucket{le="+Inf"} 3' in text
+    assert "corro_test_lat_count 3" in text
+    # same name+labels returns the same instance
+    assert reg.counter("corro.test.count") is reg.counter("corro.test.count")
+
+
+def test_histogram_timer():
+    reg = MetricsRegistry()
+    h = reg.histogram("t")
+    with h.time():
+        pass
+    assert h.total == 1
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    ctx = TraceContext.new()
+    parsed = TraceContext.parse(ctx.traceparent)
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.span_id == ctx.span_id
+    assert TraceContext.parse("garbage") is None
+
+
+def test_span_nesting_and_remote_join():
+    with span("parent") as parent:
+        with span("child") as child:
+            assert child.trace_id == parent.trace_id
+            assert child.span_id != parent.span_id
+    # joining a remote trace via traceparent
+    remote = TraceContext.new()
+    with span("server", traceparent=remote.traceparent) as joined:
+        assert joined.trace_id == remote.trace_id
+    names = [s.name for s in recent_spans()[-3:]]
+    assert names == ["child", "parent", "server"]
+
+
+# ---------------------------------------------------------------------------
+# cross-node: sync spans share one trace; prometheus endpoint live
+# ---------------------------------------------------------------------------
+
+
+def test_sync_trace_propagation_and_prometheus(tmp_path):
+    async def main():
+        prom_port = free_port()
+        from corrosion_tpu.agent.node import Node
+        from corrosion_tpu.types.config import Config
+        from corrosion_tpu.types.schema import apply_schema
+
+        g1, g2 = free_port(), free_port()
+        cfg1 = Config()
+        cfg1.db.path = ":memory:"
+        cfg1.gossip.addr = f"127.0.0.1:{g1}"
+        cfg1.telemetry.prometheus_addr = f"127.0.0.1:{prom_port}"
+        cfg1.perf.sync_interval_min = 0.3
+        cfg1.perf.sync_interval_max = 1.0
+        n1 = await Node(cfg1).start()
+        cfg2 = Config()
+        cfg2.db.path = ":memory:"
+        cfg2.gossip.addr = f"127.0.0.1:{g2}"
+        cfg2.gossip.bootstrap = [f"127.0.0.1:{g1}"]
+        cfg2.perf.sync_interval_min = 0.3
+        cfg2.perf.sync_interval_max = 1.0
+        n2 = await Node(cfg2).start()
+        try:
+            for node in (n1, n2):
+                await node.agent.pool.write_call(
+                    lambda c: apply_schema(c, SCHEMA)
+                )
+            async with CorrosionApiClient(n1.api_base) as client:
+                await client.execute(
+                    [("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "m"))]
+                )
+            # wait for replication (broadcast or sync)
+            for _ in range(100):
+                rows = await n2.agent.pool.read_call(
+                    lambda c: c.execute("SELECT COUNT(*) FROM tests").fetchone()
+                )
+                if rows[0] == 1:
+                    break
+                await asyncio.sleep(0.1)
+            assert rows[0] == 1
+
+            # a client sync span on n2 and a server span on n1 (or vice
+            # versa) must share a trace id
+            for _ in range(100):
+                spans = recent_spans()
+                clients = [s for s in spans if s.name == "sync.client"]
+                servers = [s for s in spans if s.name == "sync.server"]
+                shared = {s.trace_id for s in clients} & {
+                    s.trace_id for s in servers
+                }
+                if shared:
+                    break
+                await asyncio.sleep(0.1)
+            assert shared, "no sync round stitched client+server spans"
+
+            # prometheus endpoint serves the registry
+            async with ClientSession() as http:
+                r = await http.get(
+                    f"http://127.0.0.1:{n1.prometheus_port}/metrics"
+                )
+                text = await r.text()
+            assert r.status == 200
+            assert "corro_changes_applied" in text or "corro_broadcast_sent" in text
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())

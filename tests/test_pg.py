@@ -180,6 +180,13 @@ def test_translate_and_split():
         "SELECT 1",
         "SELECT 'a;b'",
     ]
+    # literals are never rewritten
+    assert translate_sql("SELECT 'fee is $1 per GB'") == (
+        "SELECT 'fee is $1 per GB'"
+    )
+    assert translate_sql("SELECT 'a::text', b::int FROM t WHERE c = $2") == (
+        "SELECT 'a::text', b FROM t WHERE c = ?2"
+    )
 
 
 def test_classify_with_cte():
@@ -309,6 +316,55 @@ def test_transaction_buffering_and_rollback():
         assert errors and "aborted" in errors[0]["M"]
         _, _, tags, _, status = await pg.query("COMMIT")
         assert tags == ["ROLLBACK"] and status == b"I"
+
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_script_with_explicit_begin_stays_open():
+    """A script containing its own BEGIN must leave the transaction open
+    (no implicit-close), so a later ROLLBACK still works."""
+
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+
+        _, _, tags, errors, status = await pg.query(
+            "BEGIN; INSERT INTO tests (id, text) VALUES (1, 'open')"
+        )
+        assert not errors and status == b"T"  # still in transaction
+        _, _, tags, _, status = await pg.query("ROLLBACK")
+        assert status == b"I"
+        _, rows, _, _, _ = await pg.query("SELECT COUNT(*) FROM tests")
+        assert rows == [["0"]]  # the insert was rolled back
+
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_commit_time_error_is_sql_error_not_crash():
+    """A constraint violation surfacing at implicit-commit time must
+    produce an ErrorResponse, not a dropped connection."""
+
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+
+        _, _, _, errors, status = await pg.query(
+            "INSERT INTO tests (id, text) VALUES (1, 'a'); "
+            "INSERT INTO tests (id, text) VALUES (1, 'dup')"
+        )
+        assert errors, "expected a SQL error"
+        assert status == b"I"
+        # the connection is still usable
+        _, rows, _, errors, _ = await pg.query("SELECT COUNT(*) FROM tests")
+        assert not errors and rows == [["0"]]
 
         await pg.close()
         await server.stop()

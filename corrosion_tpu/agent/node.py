@@ -108,15 +108,33 @@ class Node:
             ts=self.agent.clock.new_timestamp(),
             cluster_id=cluster_id,
         )
-        self.swim = Swim(
-            identity,
-            SwimConfig(
-                probe_period=self.config.gossip.probe_period,
-                probe_timeout=self.config.gossip.probe_timeout,
-                suspicion_timeout=self.config.gossip.suspicion_timeout,
-            ),
-            now=time.monotonic(),
+        swim_config = SwimConfig(
+            probe_period=self.config.gossip.probe_period,
+            probe_timeout=self.config.gossip.probe_timeout,
+            suspicion_timeout=self.config.gossip.suspicion_timeout,
         )
+        impl = self.config.gossip.swim_impl
+        if impl not in ("native", "python"):
+            raise ValueError(
+                f"gossip.swim_impl must be 'native' or 'python', got {impl!r}"
+            )
+        if impl == "native":
+            try:
+                from ..swim.native import NativeSwim, load as load_swim_lib
+
+                # the first call may invoke g++ — keep it off the event loop
+                await asyncio.to_thread(load_swim_lib)
+                self.swim = NativeSwim(
+                    identity, swim_config, now=time.monotonic()
+                )
+            except (RuntimeError, OSError) as e:
+                logger.warning(
+                    "native SWIM core unavailable (%s); using python core", e
+                )
+                self.swim = Swim(identity, swim_config, now=time.monotonic())
+        else:
+            self.swim = Swim(identity, swim_config, now=time.monotonic())
+        logger.debug("swim core: %s", type(self.swim).__name__)
         self.broadcast = BroadcastRuntime(
             self.transport,
             self.members,
@@ -241,18 +259,14 @@ class Node:
 
     def _on_datagram(self, addr, data: bytes) -> None:
         assert self.swim is not None
-        try:
-            msg = wire.decode_swim(data)
-            self.swim.handle(msg, time.monotonic())
-        except (wire.WireError, ValueError, TypeError, IndexError):
-            # malformed peer datagrams must not escape into the event loop's
-            # protocol callback (remotely triggerable log flood otherwise)
-            logger.debug("dropping malformed datagram from %s", addr)
+        # both cores validate + decode internally; malformed peer datagrams
+        # are dropped there and never escape into the protocol callback
+        self.swim.handle_datagram(data, time.monotonic())
 
     async def _pump_swim(self) -> None:
         assert self.swim is not None and self.transport is not None
-        for dest, msg in self.swim.take_outputs():
-            self.transport.send_datagram(dest, wire.encode_swim(msg))
+        for dest, datagram in self.swim.take_datagrams():
+            self.transport.send_datagram(dest, datagram)
         for actor, what in self.swim.take_events():
             if what == "up":
                 if self.members.add_member(actor):

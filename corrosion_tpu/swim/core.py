@@ -128,6 +128,29 @@ class Swim:
         out, self._out = self._out, []
         return out
 
+    # datagram-level adapter: the same surface NativeSwim exposes, so the
+    # node runtime drives either core identically
+
+    def handle_datagram(self, data: bytes, now: float) -> None:
+        from .. import wire
+
+        try:
+            msg = wire.decode_swim(data)
+        except wire.WireError:
+            return
+        try:
+            self.handle(msg, now)
+        except Exception:
+            # any malformed peer message shape (wrong types, maps where
+            # tuples belong, short arrays…) must die here, not in the
+            # event loop's protocol callback
+            return
+
+    def take_datagrams(self) -> List[Tuple[Tuple[str, int], bytes]]:
+        from .. import wire
+
+        return [(addr, wire.encode_swim(msg)) for addr, msg in self.take_outputs()]
+
     def take_events(self) -> List[Tuple[Actor, str]]:
         ev, self._events = self._events, []
         return ev

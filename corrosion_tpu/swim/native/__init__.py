@@ -1,0 +1,241 @@
+"""Native SWIM core: build + ctypes driver.
+
+The C++ sans-IO SWIM state machine (swim.cpp — the foca-equivalent the
+reference links as a Rust crate) compiled to ``libswim.so`` and driven via
+ctypes.  :class:`NativeSwim` presents the same surface the node runtime
+drives (datagram in / datagrams out, tick, announce/leave/rejoin, events,
+membership snapshot) and speaks the project's msgpack wire, so native and
+Python-core nodes gossip interchangeably.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import ctypes
+import os
+import random
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+from ...types.actor import Actor, ActorId
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "swim.cpp")
+OUT = os.path.join(HERE, "libswim.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def build(force: bool = False) -> str:
+    """Compile libswim.so if missing or stale; return its path.
+
+    Compiles to a temp file and atomically renames into place, so
+    concurrent processes (a SubprocessCluster fanning out nodes on a
+    fresh checkout) never load a half-written library."""
+    if (
+        not force
+        and os.path.exists(OUT)
+        and os.path.getmtime(OUT) >= os.path.getmtime(SRC)
+    ):
+        return OUT
+    tmp = OUT + f".tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall",
+        "-o", tmp, SRC,
+    ]
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise RuntimeError(
+            f"g++ failed building libswim.so (exit {res.returncode}):\n"
+            f"{res.stderr}"
+        )
+    os.replace(tmp, OUT)
+    return OUT
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(build())
+        lib.swim_new.restype = ctypes.c_void_p
+        lib.swim_new.argtypes = [
+            ctypes.c_char_p,  # id16
+            ctypes.c_char_p,  # host
+            ctypes.c_int64,  # port
+            ctypes.c_uint64,  # ts
+            ctypes.c_uint64,  # cluster_id
+            ctypes.c_double,  # probe_period
+            ctypes.c_double,  # probe_timeout
+            ctypes.c_int,  # num_indirect_probes
+            ctypes.c_double,  # suspicion_timeout
+            ctypes.c_int,  # max_piggyback
+            ctypes.c_int,  # update_retransmits
+            ctypes.c_double,  # remove_down_after
+            ctypes.c_uint64,  # seed
+            ctypes.c_double,  # now
+        ]
+        lib.swim_free.argtypes = [ctypes.c_void_p]
+        lib.swim_handle.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_double,
+        ]
+        lib.swim_tick.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.swim_announce.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.swim_leave.argtypes = [ctypes.c_void_p]
+        lib.swim_rejoin.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.swim_set_cluster.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        for fn in (
+            lib.swim_take_outputs,
+            lib.swim_take_events,
+            lib.swim_members,
+            lib.swim_identity,
+        ):
+            fn.restype = ctypes.POINTER(ctypes.c_uint8)
+            fn.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t)]
+        lib.swim_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        _lib = lib
+        return lib
+
+
+class NativeMemberView:
+    """Read-only view over one native member entry (admin dumps)."""
+
+    __slots__ = ("actor", "state", "incarnation", "state_since")
+
+    def __init__(self, actor: Actor, state: str, incarnation: int,
+                 state_since: float) -> None:
+        self.actor = actor
+        self.state = state
+        self.incarnation = incarnation
+        self.state_since = state_since
+
+
+def _actor_from_obj(o) -> Actor:
+    return Actor(
+        id=ActorId(o[0]), addr=(o[1][0], o[1][1]), ts=o[2], cluster_id=o[3]
+    )
+
+
+class NativeSwim:
+    """ctypes driver over the C++ core; drop-in for swim.core.Swim at the
+    datagram level."""
+
+    def __init__(
+        self,
+        identity: Actor,
+        config=None,  # swim.core.SwimConfig
+        rng: Optional[random.Random] = None,
+        now: float = 0.0,
+    ) -> None:
+        from ..core import SwimConfig
+
+        self._lib = load()
+        cfg = config or SwimConfig()
+        seed = (rng or random.Random()).getrandbits(63)
+        self._h = self._lib.swim_new(
+            bytes(identity.id),
+            identity.addr[0].encode(),
+            identity.addr[1],
+            identity.ts,
+            identity.cluster_id,
+            cfg.probe_period,
+            cfg.probe_timeout,
+            cfg.num_indirect_probes,
+            cfg.suspicion_timeout,
+            cfg.max_piggyback,
+            cfg.update_retransmits,
+            cfg.remove_down_after,
+            seed,
+            now,
+        )
+        self.config = cfg
+        self._identity = identity
+
+    def __del__(self) -> None:
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None) is not None:
+            self._lib.swim_free(h)
+
+    # -- identity -----------------------------------------------------------
+
+    @property
+    def identity(self) -> Actor:
+        obj, _inc = self._take(self._lib.swim_identity)
+        return _actor_from_obj(obj)
+
+    @identity.setter
+    def identity(self, actor: Actor) -> None:
+        # only cluster-id/ts changes are supported live (admin set-id)
+        self._lib.swim_set_cluster(self._h, actor.cluster_id, actor.ts)
+        self._identity = actor
+
+    @property
+    def incarnation(self) -> int:
+        _obj, inc = self._take(self._lib.swim_identity)
+        return inc
+
+    # -- datagram-level API -------------------------------------------------
+
+    def handle_datagram(self, data: bytes, now: float) -> None:
+        self._lib.swim_handle(self._h, data, len(data), now)
+
+    def tick(self, now: float) -> None:
+        self._lib.swim_tick(self._h, now)
+
+    def announce(self, addr: Tuple[str, int]) -> None:
+        self._lib.swim_announce(self._h, addr[0].encode(), addr[1])
+
+    def leave(self) -> None:
+        self._lib.swim_leave(self._h)
+
+    def rejoin(self, ts: int) -> None:
+        self._lib.swim_rejoin(self._h, ts)
+
+    def take_datagrams(self) -> List[Tuple[Tuple[str, int], bytes]]:
+        """Drain (addr, encoded-datagram) outputs, socket-ready."""
+        out = self._take(self._lib.swim_take_outputs)
+        return [((host, port), datagram) for host, port, datagram in out]
+
+    def take_events(self) -> List[Tuple[Actor, str]]:
+        out = self._take(self._lib.swim_take_events)
+        return [(_actor_from_obj(obj), what) for obj, what in out]
+
+    # -- membership ---------------------------------------------------------
+
+    @property
+    def members(self) -> Dict[ActorId, NativeMemberView]:
+        out = self._take(self._lib.swim_members)
+        result: Dict[ActorId, NativeMemberView] = {}
+        for obj, state, incarnation, state_since in out:
+            actor = _actor_from_obj(obj)
+            result[actor.id] = NativeMemberView(
+                actor, state, incarnation, state_since
+            )
+        return result
+
+    def up_members(self) -> List[Actor]:
+        return [
+            m.actor for m in self.members.values() if m.state != "down"
+        ]
+
+    # -- internals ----------------------------------------------------------
+
+    def _take(self, fn):
+        n = ctypes.c_size_t()
+        buf = fn(self._h, ctypes.byref(n))
+        try:
+            data = ctypes.string_at(buf, n.value)
+        finally:
+            self._lib.swim_buf_free(buf)
+        return msgpack.unpackb(data, raw=False)

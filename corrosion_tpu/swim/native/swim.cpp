@@ -1,0 +1,831 @@
+// Native sans-IO SWIM membership core.
+//
+// Equivalent of the `foca` crate (the Rust SWIM state machine the reference
+// drives from crates/corro-agent/src/broadcast/mod.rs:162-374) — and the
+// native counterpart of corrosion_tpu/swim/core.py, which doubles as its
+// executable spec: identical message shapes, state transitions, and timer
+// semantics, validated by running the same test scenarios against both.
+//
+// Sans-IO: the caller feeds full encoded datagrams plus explicit `now`
+// timestamps, and drains (host, port, datagram) outputs and membership
+// events.  Wire format is the project's msgpack tuple encoding
+// (corrosion_tpu/wire.py): a self-contained msgpack subset codec lives at
+// the top of this file, so native and Python nodes interoperate on the
+// same gossip wire.
+//
+// C ABI at the bottom; driven from Python via ctypes
+// (corrosion_tpu/swim/native/__init__.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <random>
+#include <string>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// msgpack subset: nil, bool, u/int 64, float64, str, bin, array
+// ---------------------------------------------------------------------------
+
+namespace mp {
+
+struct Value;
+using ValueVec = std::vector<Value>;
+
+struct Value {
+  enum class Type { Nil, Bool, Int, Uint, Float, Str, Bin, Array } type =
+      Type::Nil;
+  bool b = false;
+  int64_t i = 0;
+  uint64_t u = 0;
+  double f = 0.0;
+  std::string s;          // Str and Bin both use this storage
+  ValueVec items;
+
+  static Value nil() { return Value{}; }
+  static Value boolean(bool v) {
+    Value x; x.type = Type::Bool; x.b = v; return x;
+  }
+  static Value integer(int64_t v) {
+    Value x; x.type = Type::Int; x.i = v; return x;
+  }
+  static Value uinteger(uint64_t v) {
+    Value x; x.type = Type::Uint; x.u = v; return x;
+  }
+  static Value str(std::string v) {
+    Value x; x.type = Type::Str; x.s = std::move(v); return x;
+  }
+  static Value bin(std::string v) {
+    Value x; x.type = Type::Bin; x.s = std::move(v); return x;
+  }
+  static Value array(ValueVec v) {
+    Value x; x.type = Type::Array; x.items = std::move(v); return x;
+  }
+
+  bool is_str() const { return type == Type::Str; }
+  bool is_array() const { return type == Type::Array; }
+  uint64_t as_u64() const {
+    if (type == Type::Uint) return u;
+    if (type == Type::Int) return static_cast<uint64_t>(i);
+    return 0;
+  }
+  int64_t as_i64() const {
+    if (type == Type::Int) return i;
+    if (type == Type::Uint) return static_cast<int64_t>(u);
+    return 0;
+  }
+};
+
+inline void put_u8(std::string& out, uint8_t v) { out.push_back(char(v)); }
+inline void put_be(std::string& out, uint64_t v, int bytes) {
+  for (int i = bytes - 1; i >= 0; --i) out.push_back(char((v >> (8 * i)) & 0xff));
+}
+
+inline void encode(const Value& v, std::string& out) {
+  switch (v.type) {
+    case Value::Type::Nil: put_u8(out, 0xc0); break;
+    case Value::Type::Bool: put_u8(out, v.b ? 0xc3 : 0xc2); break;
+    case Value::Type::Int: {
+      int64_t x = v.i;
+      if (x >= 0) { encode(Value::uinteger(uint64_t(x)), out); break; }
+      if (x >= -32) { put_u8(out, uint8_t(x)); break; }
+      if (x >= INT8_MIN) { put_u8(out, 0xd0); put_u8(out, uint8_t(x)); break; }
+      if (x >= INT16_MIN) { put_u8(out, 0xd1); put_be(out, uint64_t(uint16_t(x)), 2); break; }
+      if (x >= INT32_MIN) { put_u8(out, 0xd2); put_be(out, uint64_t(uint32_t(x)), 4); break; }
+      put_u8(out, 0xd3); put_be(out, uint64_t(x), 8); break;
+    }
+    case Value::Type::Uint: {
+      uint64_t x = v.u;
+      if (x < 0x80) { put_u8(out, uint8_t(x)); break; }
+      if (x <= UINT8_MAX) { put_u8(out, 0xcc); put_u8(out, uint8_t(x)); break; }
+      if (x <= UINT16_MAX) { put_u8(out, 0xcd); put_be(out, x, 2); break; }
+      if (x <= UINT32_MAX) { put_u8(out, 0xce); put_be(out, x, 4); break; }
+      put_u8(out, 0xcf); put_be(out, x, 8); break;
+    }
+    case Value::Type::Float: {
+      put_u8(out, 0xcb);
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(v.f));
+      std::memcpy(&bits, &v.f, 8);
+      put_be(out, bits, 8);
+      break;
+    }
+    case Value::Type::Str: {
+      size_t n = v.s.size();
+      if (n < 32) put_u8(out, uint8_t(0xa0 | n));
+      else if (n <= UINT8_MAX) { put_u8(out, 0xd9); put_u8(out, uint8_t(n)); }
+      else if (n <= UINT16_MAX) { put_u8(out, 0xda); put_be(out, n, 2); }
+      else { put_u8(out, 0xdb); put_be(out, n, 4); }
+      out += v.s;
+      break;
+    }
+    case Value::Type::Bin: {
+      size_t n = v.s.size();
+      if (n <= UINT8_MAX) { put_u8(out, 0xc4); put_u8(out, uint8_t(n)); }
+      else if (n <= UINT16_MAX) { put_u8(out, 0xc5); put_be(out, n, 2); }
+      else { put_u8(out, 0xc6); put_be(out, n, 4); }
+      out += v.s;
+      break;
+    }
+    case Value::Type::Array: {
+      size_t n = v.items.size();
+      if (n < 16) put_u8(out, uint8_t(0x90 | n));
+      else if (n <= UINT16_MAX) { put_u8(out, 0xdc); put_be(out, n, 2); }
+      else { put_u8(out, 0xdd); put_be(out, n, 4); }
+      for (const auto& item : v.items) encode(item, out);
+      break;
+    }
+  }
+}
+
+struct Reader {
+  const uint8_t* p;
+  size_t n;
+  size_t off = 0;
+  bool ok = true;
+
+  uint8_t u8() {
+    if (off >= n) { ok = false; return 0; }
+    return p[off++];
+  }
+  uint64_t be(int bytes) {
+    uint64_t v = 0;
+    for (int i = 0; i < bytes; ++i) v = (v << 8) | u8();
+    return v;
+  }
+  std::string raw(size_t len) {
+    if (off + len > n) { ok = false; return {}; }
+    std::string s(reinterpret_cast<const char*>(p + off), len);
+    off += len;
+    return s;
+  }
+};
+
+inline Value decode(Reader& r, int depth = 0) {
+  if (!r.ok || depth > 32) { r.ok = false; return Value::nil(); }
+  uint8_t tag = r.u8();
+  if (!r.ok) return Value::nil();
+  if (tag < 0x80) return Value::uinteger(tag);             // pos fixint
+  if (tag >= 0xe0) return Value::integer(int8_t(tag));     // neg fixint
+  if ((tag & 0xe0) == 0xa0) return Value::str(r.raw(tag & 0x1f));
+  if ((tag & 0xf0) == 0x90) {                               // fixarray
+    ValueVec items;
+    for (int i = 0; i < (tag & 0x0f); ++i) items.push_back(decode(r, depth + 1));
+    return Value::array(std::move(items));
+  }
+  switch (tag) {
+    case 0xc0: return Value::nil();
+    case 0xc2: return Value::boolean(false);
+    case 0xc3: return Value::boolean(true);
+    case 0xc4: return Value::bin(r.raw(r.u8()));
+    case 0xc5: return Value::bin(r.raw(size_t(r.be(2))));
+    case 0xc6: return Value::bin(r.raw(size_t(r.be(4))));
+    case 0xcb: {
+      uint64_t bits = r.be(8);
+      double f;
+      std::memcpy(&f, &bits, 8);
+      Value v; v.type = Value::Type::Float; v.f = f; return v;
+    }
+    case 0xcc: return Value::uinteger(r.u8());
+    case 0xcd: return Value::uinteger(r.be(2));
+    case 0xce: return Value::uinteger(r.be(4));
+    case 0xcf: return Value::uinteger(r.be(8));
+    case 0xd0: return Value::integer(int8_t(r.u8()));
+    case 0xd1: return Value::integer(int16_t(r.be(2)));
+    case 0xd2: return Value::integer(int32_t(r.be(4)));
+    case 0xd3: return Value::integer(int64_t(r.be(8)));
+    case 0xd9: return Value::str(r.raw(r.u8()));
+    case 0xda: return Value::str(r.raw(size_t(r.be(2))));
+    case 0xdb: return Value::str(r.raw(size_t(r.be(4))));
+    case 0xdc: case 0xdd: {
+      size_t count = (tag == 0xdc) ? size_t(r.be(2)) : size_t(r.be(4));
+      if (count > 1u << 20) { r.ok = false; return Value::nil(); }
+      ValueVec items;
+      for (size_t i = 0; i < count; ++i) items.push_back(decode(r, depth + 1));
+      return Value::array(std::move(items));
+    }
+    default:
+      r.ok = false;  // maps/ext unsupported: not part of the swim wire
+      return Value::nil();
+  }
+}
+
+}  // namespace mp
+
+// ---------------------------------------------------------------------------
+// SWIM core
+// ---------------------------------------------------------------------------
+
+namespace swim {
+
+constexpr const char* ALIVE = "alive";
+constexpr const char* SUSPECT = "suspect";
+constexpr const char* DOWN = "down";
+
+struct Actor {
+  std::string id;      // 16-byte site id
+  std::string host;
+  int64_t port = 0;
+  uint64_t ts = 0;     // identity timestamp (renew() bumps)
+  uint64_t cluster_id = 0;
+
+  mp::Value to_obj() const {
+    mp::ValueVec addr;
+    addr.push_back(mp::Value::str(host));
+    addr.push_back(mp::Value::integer(port));
+    mp::ValueVec obj;
+    obj.push_back(mp::Value::bin(id));
+    obj.push_back(mp::Value::array(std::move(addr)));
+    obj.push_back(mp::Value::uinteger(ts));
+    obj.push_back(mp::Value::uinteger(cluster_id));
+    return mp::Value::array(std::move(obj));
+  }
+
+  static bool from_obj(const mp::Value& v, Actor& out) {
+    if (!v.is_array() || v.items.size() < 4) return false;
+    const auto& addr = v.items[1];
+    if (!addr.is_array() || addr.items.size() < 2) return false;
+    out.id = v.items[0].s;
+    out.host = addr.items[0].s;
+    out.port = addr.items[1].as_i64();
+    out.ts = v.items[2].as_u64();
+    out.cluster_id = v.items[3].as_u64();
+    return out.id.size() == 16 && !out.host.empty();
+  }
+};
+
+struct Config {
+  double probe_period = 1.0;
+  double probe_timeout = 0.5;
+  int num_indirect_probes = 3;
+  double suspicion_timeout = 3.0;
+  int max_piggyback = 8;
+  int update_retransmits = 6;
+  double remove_down_after = 48 * 3600.0;
+};
+
+struct MemberEntry {
+  Actor actor;
+  std::string state = ALIVE;
+  uint64_t incarnation = 0;
+  double state_since = 0.0;
+};
+
+struct Update {
+  mp::Value actor_obj;
+  std::string state;
+  uint64_t incarnation;
+  int sends_left;
+};
+
+struct Probe {
+  std::string target_id;
+  double direct_deadline;
+  double indirect_deadline;
+  bool acked = false;
+  bool indirect_sent = false;
+};
+
+struct Output {
+  std::string host;
+  int64_t port;
+  std::string datagram;  // full encoded ("swim", ...) payload
+};
+
+struct Event {
+  Actor actor;
+  std::string what;  // "up" | "down"
+};
+
+class Core {
+ public:
+  Core(Actor identity, Config cfg, uint64_t seed, double now)
+      : identity_(std::move(identity)), cfg_(cfg), rng_(seed) {
+    std::uniform_real_distribution<double> jitter(0.0, cfg_.probe_period);
+    next_probe_at_ = now + jitter(rng_);
+  }
+
+  Actor identity_;
+  Config cfg_;
+  uint64_t incarnation_ = 0;
+  std::map<std::string, MemberEntry> members_;
+  std::vector<Output> out_;
+  std::vector<Event> events_;
+  bool left_ = false;
+
+  // -- joining ------------------------------------------------------------
+
+  void announce(const std::string& host, int64_t port) {
+    mp::ValueVec msg;
+    msg.push_back(mp::Value::str("announce"));
+    msg.push_back(identity_.to_obj());
+    emit(host, port, std::move(msg));
+  }
+
+  void leave() {
+    left_ = true;
+    incarnation_ += 1;
+    for (auto& [id, m] : members_) {
+      if (m.state == DOWN) continue;
+      mp::ValueVec msg;
+      msg.push_back(mp::Value::str("leave"));
+      msg.push_back(identity_.to_obj());
+      emit(m.actor.host, m.actor.port, std::move(msg));
+    }
+  }
+
+  void rejoin(uint64_t ts) {
+    identity_.ts = ts;
+    left_ = false;
+    incarnation_ = 0;
+    for (auto& [id, m] : members_) {
+      if (m.state == DOWN) continue;
+      mp::ValueVec msg;
+      msg.push_back(mp::Value::str("announce"));
+      msg.push_back(identity_.to_obj());
+      emit(m.actor.host, m.actor.port, std::move(msg));
+    }
+  }
+
+  void set_cluster(uint64_t cluster_id, uint64_t ts) {
+    identity_.cluster_id = cluster_id;
+    identity_.ts = ts;
+  }
+
+  // -- timers -------------------------------------------------------------
+
+  void tick(double now) {
+    if (left_) return;
+    // probe deadlines
+    for (auto it = probes_.begin(); it != probes_.end();) {
+      Probe& pr = it->second;
+      auto found = members_.find(pr.target_id);
+      if (pr.acked || found == members_.end() || found->second.state == DOWN) {
+        it = probes_.erase(it);
+        continue;
+      }
+      MemberEntry& entry = found->second;
+      if (now >= pr.direct_deadline && !pr.indirect_sent) {
+        pr.indirect_sent = true;
+        std::vector<MemberEntry*> helpers;
+        for (auto& [id, m] : members_)
+          if (m.state == ALIVE && id != pr.target_id) helpers.push_back(&m);
+        std::shuffle(helpers.begin(), helpers.end(), rng_);
+        int count = std::min<int>(cfg_.num_indirect_probes, helpers.size());
+        for (int i = 0; i < count; ++i) {
+          mp::ValueVec msg;
+          msg.push_back(mp::Value::str("ping_req"));
+          msg.push_back(mp::Value::uinteger(it->first));
+          msg.push_back(identity_.to_obj());
+          msg.push_back(entry.actor.to_obj());
+          msg.push_back(piggyback());
+          emit(helpers[i]->actor.host, helpers[i]->actor.port, std::move(msg));
+        }
+        ++it;
+      } else if (now >= pr.indirect_deadline) {
+        suspect(entry, now);
+        it = probes_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    // suspicion expiry + down GC
+    for (auto it = members_.begin(); it != members_.end();) {
+      MemberEntry& entry = it->second;
+      if (entry.state == SUSPECT &&
+          now - entry.state_since >= cfg_.suspicion_timeout) {
+        declare_down(entry, now);
+        ++it;
+      } else if (entry.state == DOWN &&
+                 now - entry.state_since >= cfg_.remove_down_after) {
+        it = members_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    // probe round
+    if (now >= next_probe_at_) {
+      next_probe_at_ = now + cfg_.probe_period;
+      probe_next(now);
+    }
+  }
+
+  // -- message handling ---------------------------------------------------
+
+  void handle_datagram(const uint8_t* data, size_t len, double now) {
+    if (left_) return;
+    mp::Reader r{data, len};
+    mp::Value v = mp::decode(r);
+    if (!r.ok || !v.is_array() || v.items.size() < 2) return;
+    if (!v.items[0].is_str() || v.items[0].s != "swim") return;
+    const std::string& kind = v.items[1].s;
+    const mp::ValueVec& m = v.items;
+    // m[0]="swim", m[1]=kind, rest per message shape
+    if (kind == "ping" && m.size() >= 5) {
+      uint64_t seq = m[2].as_u64();
+      Actor sender;
+      if (!Actor::from_obj(m[3], sender)) return;
+      observe_alive(sender, 0, now, /*direct=*/true);
+      apply_piggyback(m[4], now);
+      mp::ValueVec msg;
+      msg.push_back(mp::Value::str("ack"));
+      msg.push_back(mp::Value::uinteger(seq));
+      msg.push_back(identity_.to_obj());
+      msg.push_back(piggyback());
+      emit(sender.host, sender.port, std::move(msg));
+    } else if (kind == "fwd_ping" && m.size() >= 6) {
+      uint64_t seq = m[2].as_u64();
+      Actor origin, from;
+      if (!Actor::from_obj(m[3], origin) || !Actor::from_obj(m[4], from))
+        return;
+      observe_alive(from, 0, now, /*direct=*/true);
+      observe_alive(origin, 0, now, /*direct=*/false);
+      apply_piggyback(m[5], now);
+      mp::ValueVec msg;
+      msg.push_back(mp::Value::str("ack"));
+      msg.push_back(mp::Value::uinteger(seq));
+      msg.push_back(identity_.to_obj());
+      msg.push_back(piggyback());
+      emit(origin.host, origin.port, std::move(msg));
+    } else if (kind == "ping_req" && m.size() >= 6) {
+      uint64_t seq = m[2].as_u64();
+      Actor target;
+      if (!Actor::from_obj(m[4], target)) return;
+      apply_piggyback(m[5], now);
+      mp::ValueVec msg;
+      msg.push_back(mp::Value::str("fwd_ping"));
+      msg.push_back(mp::Value::uinteger(seq));
+      msg.push_back(m[3]);  // origin obj forwarded verbatim
+      msg.push_back(identity_.to_obj());
+      msg.push_back(piggyback());
+      emit(target.host, target.port, std::move(msg));
+    } else if (kind == "ack" && m.size() >= 5) {
+      uint64_t seq = m[2].as_u64();
+      Actor sender;
+      if (!Actor::from_obj(m[3], sender)) return;
+      apply_piggyback(m[4], now);
+      auto pit = probes_.find(seq);
+      if (pit != probes_.end() && pit->second.target_id == sender.id) {
+        probes_.erase(pit);
+      }
+      auto found = members_.find(sender.id);
+      if (found != members_.end() && found->second.state == SUSPECT) {
+        found->second.state = ALIVE;
+        found->second.state_since = now;
+        queue_update(sender, ALIVE, found->second.incarnation);
+      } else {
+        observe_alive(sender, 0, now, /*direct=*/true);
+      }
+    } else if (kind == "announce" && m.size() >= 3) {
+      Actor sender;
+      if (!Actor::from_obj(m[2], sender)) return;
+      observe_alive(sender, 0, now, /*direct=*/true);
+      std::vector<MemberEntry*> feed;
+      for (auto& [id, mem] : members_)
+        if (mem.state == ALIVE && id != sender.id) feed.push_back(&mem);
+      std::shuffle(feed.begin(), feed.end(), rng_);
+      mp::ValueVec actors;
+      int count = std::min<int>(10, feed.size());
+      for (int i = 0; i < count; ++i) actors.push_back(feed[i]->actor.to_obj());
+      mp::ValueVec msg;
+      msg.push_back(mp::Value::str("feed"));
+      msg.push_back(identity_.to_obj());
+      msg.push_back(mp::Value::array(std::move(actors)));
+      msg.push_back(piggyback());
+      emit(sender.host, sender.port, std::move(msg));
+    } else if (kind == "feed" && m.size() >= 5) {
+      Actor sender;
+      if (!Actor::from_obj(m[2], sender)) return;
+      observe_alive(sender, 0, now, /*direct=*/true);
+      if (m[3].is_array()) {
+        for (const auto& obj : m[3].items) {
+          Actor a;
+          if (Actor::from_obj(obj, a)) observe_alive(a, 0, now, false);
+        }
+      }
+      apply_piggyback(m[4], now);
+    } else if (kind == "leave" && m.size() >= 3) {
+      Actor actor;
+      if (!Actor::from_obj(m[2], actor)) return;
+      auto found = members_.find(actor.id);
+      if (found != members_.end() && actor.ts >= found->second.actor.ts) {
+        declare_down(found->second, now);
+      }
+    }
+  }
+
+  // -- draining -----------------------------------------------------------
+
+  std::string take_outputs() {
+    mp::ValueVec arr;
+    for (auto& o : out_) {
+      mp::ValueVec entry;
+      entry.push_back(mp::Value::str(o.host));
+      entry.push_back(mp::Value::integer(o.port));
+      entry.push_back(mp::Value::bin(std::move(o.datagram)));
+      arr.push_back(mp::Value::array(std::move(entry)));
+    }
+    out_.clear();
+    std::string buf;
+    mp::encode(mp::Value::array(std::move(arr)), buf);
+    return buf;
+  }
+
+  std::string take_events() {
+    mp::ValueVec arr;
+    for (auto& e : events_) {
+      mp::ValueVec entry;
+      entry.push_back(e.actor.to_obj());
+      entry.push_back(mp::Value::str(e.what));
+      arr.push_back(mp::Value::array(std::move(entry)));
+    }
+    events_.clear();
+    std::string buf;
+    mp::encode(mp::Value::array(std::move(arr)), buf);
+    return buf;
+  }
+
+  std::string members_snapshot() {
+    mp::ValueVec arr;
+    for (auto& [id, m] : members_) {
+      mp::ValueVec entry;
+      entry.push_back(m.actor.to_obj());
+      entry.push_back(mp::Value::str(m.state));
+      entry.push_back(mp::Value::uinteger(m.incarnation));
+      entry.push_back([&] {
+        mp::Value v; v.type = mp::Value::Type::Float; v.f = m.state_since;
+        return v;
+      }());
+      arr.push_back(mp::Value::array(std::move(entry)));
+    }
+    std::string buf;
+    mp::encode(mp::Value::array(std::move(arr)), buf);
+    return buf;
+  }
+
+  std::string identity_snapshot() {
+    mp::ValueVec entry;
+    entry.push_back(identity_.to_obj());
+    entry.push_back(mp::Value::uinteger(incarnation_));
+    std::string buf;
+    mp::encode(mp::Value::array(std::move(entry)), buf);
+    return buf;
+  }
+
+ private:
+  std::mt19937_64 rng_;
+  std::vector<Update> updates_;
+  std::map<uint64_t, Probe> probes_;
+  std::vector<std::string> probe_queue_;
+  uint64_t probe_seq_ = 0;
+  double next_probe_at_ = 0.0;
+
+  void emit(const std::string& host, int64_t port, mp::ValueVec msg) {
+    mp::ValueVec tagged;
+    tagged.push_back(mp::Value::str("swim"));
+    for (auto& v : msg) tagged.push_back(std::move(v));
+    std::string buf;
+    mp::encode(mp::Value::array(std::move(tagged)), buf);
+    out_.push_back(Output{host, port, std::move(buf)});
+  }
+
+  void queue_update(const Actor& actor, const std::string& state,
+                    uint64_t incarnation) {
+    updates_.insert(updates_.begin(),
+                    Update{actor.to_obj(), state, incarnation,
+                           cfg_.update_retransmits});
+  }
+
+  mp::Value piggyback() {
+    mp::ValueVec out;
+    for (auto it = updates_.begin();
+         it != updates_.end() && int(out.size()) < cfg_.max_piggyback;) {
+      mp::ValueVec entry;
+      entry.push_back(it->actor_obj);
+      entry.push_back(mp::Value::str(it->state));
+      entry.push_back(mp::Value::uinteger(it->incarnation));
+      out.push_back(mp::Value::array(std::move(entry)));
+      it->sends_left -= 1;
+      if (it->sends_left <= 0)
+        it = updates_.erase(it);
+      else
+        ++it;
+    }
+    return mp::Value::array(std::move(out));
+  }
+
+  void probe_next(double now) {
+    std::vector<std::string> candidates;
+    for (auto& [id, m] : members_)
+      if (m.state != DOWN) candidates.push_back(id);
+    if (candidates.empty()) return;
+    if (probe_queue_.empty()) {
+      probe_queue_ = candidates;
+      std::shuffle(probe_queue_.begin(), probe_queue_.end(), rng_);
+    }
+    while (!probe_queue_.empty()) {
+      std::string target_id = probe_queue_.front();
+      probe_queue_.erase(probe_queue_.begin());
+      auto found = members_.find(target_id);
+      if (found == members_.end() || found->second.state == DOWN) continue;
+      probe_seq_ += 1;
+      probes_[probe_seq_] = Probe{target_id, now + cfg_.probe_timeout,
+                                  now + 2 * cfg_.probe_timeout};
+      mp::ValueVec msg;
+      msg.push_back(mp::Value::str("ping"));
+      msg.push_back(mp::Value::uinteger(probe_seq_));
+      msg.push_back(identity_.to_obj());
+      msg.push_back(piggyback());
+      emit(found->second.actor.host, found->second.actor.port, std::move(msg));
+      return;
+    }
+  }
+
+  void suspect(MemberEntry& entry, double now) {
+    if (entry.state != ALIVE) return;
+    entry.state = SUSPECT;
+    entry.state_since = now;
+    queue_update(entry.actor, SUSPECT, entry.incarnation);
+  }
+
+  void declare_down(MemberEntry& entry, double now) {
+    if (entry.state == DOWN) return;
+    entry.state = DOWN;
+    entry.state_since = now;
+    queue_update(entry.actor, DOWN, entry.incarnation);
+    events_.push_back(Event{entry.actor, "down"});
+  }
+
+  void observe_alive(const Actor& actor, uint64_t incarnation, double now,
+                     bool direct) {
+    if (actor.id == identity_.id) return;
+    auto found = members_.find(actor.id);
+    if (found == members_.end()) {
+      members_[actor.id] =
+          MemberEntry{actor, ALIVE, incarnation, now};
+      queue_update(actor, ALIVE, incarnation);
+      events_.push_back(Event{actor, "up"});
+      return;
+    }
+    MemberEntry& entry = found->second;
+    bool newer_identity = actor.ts > entry.actor.ts;
+    bool higher_inc =
+        actor.ts == entry.actor.ts && incarnation > entry.incarnation;
+    bool direct_revive =
+        direct && actor.ts >= entry.actor.ts && entry.state != ALIVE;
+    if (newer_identity || higher_inc || direct_revive) {
+      bool was_not_alive = entry.state != ALIVE;
+      if (newer_identity)
+        entry.incarnation = incarnation;  // fresh incarnation stream
+      else
+        entry.incarnation = std::max(incarnation, entry.incarnation);
+      entry.actor = actor;
+      entry.state = ALIVE;
+      entry.state_since = now;
+      queue_update(actor, ALIVE, entry.incarnation);
+      if (was_not_alive) events_.push_back(Event{actor, "up"});
+    }
+  }
+
+  void observe_suspect(const Actor& actor, uint64_t incarnation, double now) {
+    if (actor.id == identity_.id) {
+      incarnation_ = std::max(incarnation_, incarnation) + 1;
+      queue_update(identity_, ALIVE, incarnation_);
+      return;
+    }
+    auto found = members_.find(actor.id);
+    if (found == members_.end()) {
+      members_[actor.id] = MemberEntry{actor, SUSPECT, incarnation, now};
+      queue_update(actor, SUSPECT, incarnation);
+      events_.push_back(Event{actor, "up"});  // first sighting, albeit suspect
+      return;
+    }
+    MemberEntry& entry = found->second;
+    if (actor.ts < entry.actor.ts) return;
+    if (incarnation >= entry.incarnation && entry.state == ALIVE) {
+      entry.state = SUSPECT;
+      entry.state_since = now;
+      entry.incarnation = incarnation;
+      queue_update(actor, SUSPECT, incarnation);
+    }
+  }
+
+  void observe_down(const Actor& actor, uint64_t incarnation, double now) {
+    if (actor.id == identity_.id) {
+      incarnation_ = std::max(incarnation_, incarnation) + 1;
+      queue_update(identity_, ALIVE, incarnation_);
+      return;
+    }
+    auto found = members_.find(actor.id);
+    if (found == members_.end()) return;
+    MemberEntry& entry = found->second;
+    if (actor.ts < entry.actor.ts) return;
+    if (actor.ts > entry.actor.ts || incarnation >= entry.incarnation) {
+      if (entry.state != DOWN) declare_down(entry, now);
+    }
+  }
+
+  void apply_piggyback(const mp::Value& pb, double now) {
+    if (!pb.is_array()) return;
+    for (const auto& item : pb.items) {
+      if (!item.is_array() || item.items.size() < 3) continue;
+      Actor actor;
+      if (!Actor::from_obj(item.items[0], actor)) continue;
+      const std::string& state = item.items[1].s;
+      uint64_t inc = item.items[2].as_u64();
+      if (state == ALIVE)
+        observe_alive(actor, inc, now, false);
+      else if (state == SUSPECT)
+        observe_suspect(actor, inc, now);
+      else if (state == DOWN)
+        observe_down(actor, inc, now);
+    }
+  }
+};
+
+}  // namespace swim
+
+// ---------------------------------------------------------------------------
+// C ABI (driven via ctypes)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* swim_new(const uint8_t* id16, const char* host, int64_t port,
+               uint64_t ts, uint64_t cluster_id, double probe_period,
+               double probe_timeout, int num_indirect_probes,
+               double suspicion_timeout, int max_piggyback,
+               int update_retransmits, double remove_down_after,
+               uint64_t seed, double now) {
+  swim::Actor identity;
+  identity.id.assign(reinterpret_cast<const char*>(id16), 16);
+  identity.host = host;
+  identity.port = port;
+  identity.ts = ts;
+  identity.cluster_id = cluster_id;
+  swim::Config cfg;
+  cfg.probe_period = probe_period;
+  cfg.probe_timeout = probe_timeout;
+  cfg.num_indirect_probes = num_indirect_probes;
+  cfg.suspicion_timeout = suspicion_timeout;
+  cfg.max_piggyback = max_piggyback;
+  cfg.update_retransmits = update_retransmits;
+  cfg.remove_down_after = remove_down_after;
+  return new swim::Core(std::move(identity), cfg, seed, now);
+}
+
+void swim_free(void* h) { delete static_cast<swim::Core*>(h); }
+
+void swim_handle(void* h, const uint8_t* data, size_t len, double now) {
+  static_cast<swim::Core*>(h)->handle_datagram(data, len, now);
+}
+
+void swim_tick(void* h, double now) {
+  static_cast<swim::Core*>(h)->tick(now);
+}
+
+void swim_announce(void* h, const char* host, int64_t port) {
+  static_cast<swim::Core*>(h)->announce(host, port);
+}
+
+void swim_leave(void* h) { static_cast<swim::Core*>(h)->leave(); }
+
+void swim_rejoin(void* h, uint64_t ts) {
+  static_cast<swim::Core*>(h)->rejoin(ts);
+}
+
+void swim_set_cluster(void* h, uint64_t cluster_id, uint64_t ts) {
+  static_cast<swim::Core*>(h)->set_cluster(cluster_id, ts);
+}
+
+// Buffer hand-off: each take_* copies into a malloc'd buffer the caller
+// frees with swim_buf_free.
+static uint8_t* to_buf(const std::string& s, size_t* len) {
+  *len = s.size();
+  uint8_t* buf = static_cast<uint8_t*>(malloc(s.size() ? s.size() : 1));
+  std::memcpy(buf, s.data(), s.size());
+  return buf;
+}
+
+uint8_t* swim_take_outputs(void* h, size_t* len) {
+  return to_buf(static_cast<swim::Core*>(h)->take_outputs(), len);
+}
+
+uint8_t* swim_take_events(void* h, size_t* len) {
+  return to_buf(static_cast<swim::Core*>(h)->take_events(), len);
+}
+
+uint8_t* swim_members(void* h, size_t* len) {
+  return to_buf(static_cast<swim::Core*>(h)->members_snapshot(), len);
+}
+
+uint8_t* swim_identity(void* h, size_t* len) {
+  return to_buf(static_cast<swim::Core*>(h)->identity_snapshot(), len);
+}
+
+void swim_buf_free(uint8_t* buf) { free(buf); }
+
+}  // extern "C"

@@ -55,6 +55,11 @@ class GossipConfig:
     probe_period: float = 1.0
     probe_timeout: float = 0.5
     suspicion_timeout: float = 3.0
+    # SWIM core implementation: "native" (C++ sans-IO core, the default —
+    # the foca-equivalent is a native component in the reference) or
+    # "python" (the executable spec in swim/core.py); both speak the same
+    # wire and interoperate in one cluster
+    swim_impl: str = "native"
 
 
 @dataclass

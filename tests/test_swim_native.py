@@ -1,0 +1,230 @@
+"""Native C++ SWIM core tests (swim/native/swim.cpp — the foca-equivalent
+native component).
+
+The same virtual-time scenarios as test_swim.py run at the datagram level
+against three cluster flavors: all-Python cores, all-native cores, and a
+MIXED cluster — proving the C++ core is semantics- and wire-compatible
+with the Python executable spec."""
+
+import random
+
+import pytest
+
+from corrosion_tpu.swim.core import ALIVE, DOWN, SUSPECT, Swim, SwimConfig
+from corrosion_tpu.swim.native import NativeSwim, build
+from corrosion_tpu.types.actor import Actor, ActorId
+from corrosion_tpu.wire import actor_to_obj, pack
+
+build()  # compile once up front
+
+
+class DatagramNet:
+    """In-memory datagram network over the impl-agnostic swim surface."""
+
+    def __init__(self, impls, cfg=None, seed=1):
+        self.impls = impls  # iterator over "python" | "native" per add()
+        self.cfg = cfg or SwimConfig()
+        self.rng = random.Random(seed)
+        self.nodes = {}
+        self.partitioned = set()
+        self.events = []
+        self._n = 0
+
+    def add(self, port):
+        addr = ("127.0.0.1", port)
+        actor = Actor(id=ActorId.random(), addr=addr, ts=1)
+        impl = self.impls[self._n % len(self.impls)]
+        self._n += 1
+        rng = random.Random(self.rng.randrange(1 << 30))
+        cls = NativeSwim if impl == "native" else Swim
+        swim = cls(actor, self.cfg, rng=rng, now=0.0)
+        self.nodes[addr] = swim
+        return swim
+
+    def inject(self, dest_swim, msg_tuple, now):
+        """Deliver a raw (forged) swim message tuple as a datagram."""
+        dest_swim.handle_datagram(pack(("swim",) + msg_tuple), now)
+
+    def run(self, until, dt=0.1, start=0.0):
+        now = start
+        while now < until:
+            for swim in self.nodes.values():
+                swim.tick(now)
+            for _ in range(10):
+                moved = False
+                for addr, swim in self.nodes.items():
+                    if addr in self.partitioned:
+                        swim.take_datagrams()
+                        continue
+                    for dest, datagram in swim.take_datagrams():
+                        moved = True
+                        if dest in self.partitioned:
+                            continue
+                        target = self.nodes.get(dest)
+                        if target is not None:
+                            target.handle_datagram(datagram, now)
+                for addr, swim in self.nodes.items():
+                    for actor, what in swim.take_events():
+                        self.events.append((addr, actor, what))
+                if not moved:
+                    break
+            now += dt
+        return now
+
+
+FLAVORS = {
+    "python": ["python"],
+    "native": ["native"],
+    "mixed": ["python", "native"],
+}
+
+
+@pytest.fixture(params=sorted(FLAVORS))
+def impls(request):
+    return FLAVORS[request.param]
+
+
+def test_three_node_join(impls):
+    net = DatagramNet(impls)
+    a, b, c = net.add(1), net.add(2), net.add(3)
+    b.announce(a.identity.addr)
+    c.announce(a.identity.addr)
+    net.run(until=5.0)
+    for swim in (a, b, c):
+        assert len(swim.up_members()) == 2
+
+
+def test_failure_detection(impls):
+    cfg = SwimConfig(probe_period=0.5, probe_timeout=0.2, suspicion_timeout=1.5)
+    net = DatagramNet(impls, cfg)
+    a, b, c = net.add(1), net.add(2), net.add(3)
+    b.announce(a.identity.addr)
+    c.announce(a.identity.addr)
+    net.run(until=3.0)
+    net.partitioned.add(b.identity.addr)
+    net.run(until=15.0, start=3.0)
+    for swim in (a, c):
+        assert swim.members[b.identity.id].state == DOWN
+    downs = {(e[0], e[2]) for e in net.events if e[2] == "down"}
+    assert (a.identity.addr, "down") in downs
+    assert (c.identity.addr, "down") in downs
+
+
+def test_refutation_of_false_suspicion(impls):
+    cfg = SwimConfig(probe_period=0.5, probe_timeout=0.2, suspicion_timeout=5.0)
+    net = DatagramNet(impls, cfg)
+    a, b = net.add(1), net.add(2)
+    b.announce(a.identity.addr)
+    net.run(until=2.0)
+    # forged gossip: a third party pings `a` with a piggybacked suspicion
+    # about b
+    rumor = Actor(id=ActorId.random(), addr=("127.0.0.1", 99), ts=1)
+    net.inject(
+        a,
+        (
+            "ping",
+            12345,
+            list(actor_to_obj(rumor)),
+            [[list(actor_to_obj(b.identity)), SUSPECT, 0]],
+        ),
+        2.0,
+    )
+    assert a.members[b.identity.id].state == SUSPECT
+    net.run(until=6.0, start=2.0)
+    assert a.members[b.identity.id].state == ALIVE
+    assert b.incarnation >= 1
+
+
+def test_graceful_leave(impls):
+    net = DatagramNet(impls)
+    a, b = net.add(1), net.add(2)
+    b.announce(a.identity.addr)
+    net.run(until=2.0)
+    b.leave()
+    net.run(until=3.0, start=2.0)
+    assert a.members[b.identity.id].state == DOWN
+
+
+def test_rejoin_with_renewed_identity(impls):
+    cfg = SwimConfig(probe_period=0.5, probe_timeout=0.2, suspicion_timeout=1.0)
+    net = DatagramNet(impls, cfg)
+    a, b = net.add(1), net.add(2)
+    b.announce(a.identity.addr)
+    net.run(until=2.0)
+    net.partitioned.add(b.identity.addr)
+    net.run(until=10.0, start=2.0)
+    assert a.members[b.identity.id].state == DOWN
+
+    # b renews in place (same core, bumped identity ts) and re-announces
+    net.partitioned.discard(b.identity.addr)
+    b.rejoin(5)
+    b.announce(a.identity.addr)
+    net.run(until=13.0, start=10.0)
+    assert a.members[b.identity.id].state == ALIVE
+    assert a.members[b.identity.id].actor.ts == 5
+
+
+def test_partition_heal_revives_down_members(impls):
+    cfg = SwimConfig(probe_period=0.3, probe_timeout=0.1, suspicion_timeout=0.8)
+    net = DatagramNet(impls, cfg)
+    a, b = net.add(1), net.add(2)
+    b.announce(a.identity.addr)
+    net.run(until=2.0)
+    net.partitioned.add(b.identity.addr)
+    net.run(until=8.0, start=2.0)
+    assert a.members[b.identity.id].state == DOWN
+    assert b.members[a.identity.id].state == DOWN
+    net.partitioned.discard(b.identity.addr)
+    b.announce(a.identity.addr)
+    net.run(until=12.0, start=8.0)
+    assert a.members[b.identity.id].state == ALIVE
+    assert b.members[a.identity.id].state == ALIVE
+
+
+def test_stale_down_update_cannot_kill_rejoined_node(impls):
+    net = DatagramNet(impls)
+    a, b = net.add(1), net.add(2)
+    b.announce(a.identity.addr)
+    net.run(until=2.0)
+    old_identity = b.identity  # ts=1
+    renewed = old_identity.renew(ts=5)
+    # direct announce from the renewed identity
+    net.inject(a, ("announce", list(actor_to_obj(renewed))), 2.0)
+    assert a.members[old_identity.id].actor.ts == 5
+    # stale down gossip about the ts=1 identity arrives late via a ping
+    rumor = Actor(id=ActorId.random(), addr=("127.0.0.1", 98), ts=1)
+    net.inject(
+        a,
+        (
+            "ping",
+            999,
+            list(actor_to_obj(rumor)),
+            [[list(actor_to_obj(old_identity)), DOWN, 0]],
+        ),
+        2.1,
+    )
+    assert a.members[old_identity.id].state == ALIVE
+
+
+def test_larger_cluster_converges_membership(impls):
+    cfg = SwimConfig(probe_period=0.3, probe_timeout=0.1)
+    net = DatagramNet(impls, cfg, seed=42)
+    nodes = [net.add(i) for i in range(1, 16)]
+    for n in nodes[1:]:
+        n.announce(nodes[0].identity.addr)
+    net.run(until=10.0)
+    for swim in nodes:
+        assert len(swim.up_members()) == 14
+
+
+def test_malformed_datagrams_are_dropped(impls):
+    net = DatagramNet(impls)
+    a, b = net.add(1), net.add(2)
+    b.announce(a.identity.addr)
+    net.run(until=2.0)
+    for garbage in (b"", b"\x00", b"\xff" * 64, pack(("swim",)), pack("x")):
+        a.handle_datagram(garbage, 2.0)
+    # truncated real message
+    good = pack(("swim", "ping", 1, list(actor_to_obj(b.identity)), []))
+    a.handle_datagram(good[: len(good) // 2], 2.0)
+    assert a.members[b.identity.id].state == ALIVE  # unharmed

@@ -92,12 +92,31 @@ class Node:
 
         self.members = Members(self.agent.actor_id)
         self.sync_server = SyncServer(self.agent, cluster_id)
+        ssl_server = ssl_client = None
+        tls = self.config.gossip.tls
+        if tls is not None and not self.config.gossip.plaintext:
+            from ..utils.tls import client_context, server_context
+
+            ssl_server = server_context(
+                tls.cert_file,
+                tls.key_file,
+                ca_file=tls.ca_file,
+                require_client_cert=tls.mtls,
+            )
+            ssl_client = client_context(
+                ca_file=tls.ca_file,
+                cert_file=tls.client_cert_file if tls.mtls else None,
+                key_file=tls.client_key_file if tls.mtls else None,
+                insecure=tls.insecure,
+            )
         self.transport = Transport(
             host=gossip_host,
             port=gossip_port,
             on_datagram=self._on_datagram,
             on_uni_frame=self._on_uni_frame,
             on_bi_stream=self._on_bi_stream,
+            ssl_server=ssl_server,
+            ssl_client=ssl_client,
         )
         addr = await self.transport.start()
         self.transport.on_rtt = lambda a, rtt: self._on_rtt(a, rtt)

@@ -43,10 +43,12 @@ def find_lib() -> str:
 
 def build(force: bool = False) -> str:
     """Compile crsqlite.so if missing or stale; return its path."""
+    # strict '>': a git checkout gives source and committed binary the
+    # SAME mtime, which must count as stale (one rebuild re-validates)
     if (
         not force
         and os.path.exists(OUT)
-        and os.path.getmtime(OUT) >= os.path.getmtime(SRC)
+        and os.path.getmtime(OUT) > os.path.getmtime(SRC)
     ):
         return OUT
     cmd = [

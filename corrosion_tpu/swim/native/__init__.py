@@ -36,10 +36,12 @@ def build(force: bool = False) -> str:
     Compiles to a temp file and atomically renames into place, so
     concurrent processes (a SubprocessCluster fanning out nodes on a
     fresh checkout) never load a half-written library."""
+    # strict '>': a git checkout gives source and committed binary the
+    # SAME mtime, which must count as stale (one rebuild re-validates)
     if (
         not force
         and os.path.exists(OUT)
-        and os.path.getmtime(OUT) >= os.path.getmtime(SRC)
+        and os.path.getmtime(OUT) > os.path.getmtime(SRC)
     ):
         return OUT
     tmp = OUT + f".tmp.{os.getpid()}"

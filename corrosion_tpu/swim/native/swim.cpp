@@ -173,7 +173,8 @@ inline Value decode(Reader& r, int depth = 0) {
   if ((tag & 0xe0) == 0xa0) return Value::str(r.raw(tag & 0x1f));
   if ((tag & 0xf0) == 0x90) {                               // fixarray
     ValueVec items;
-    for (int i = 0; i < (tag & 0x0f); ++i) items.push_back(decode(r, depth + 1));
+    for (int i = 0; i < (tag & 0x0f) && r.ok; ++i)
+      items.push_back(decode(r, depth + 1));
     return Value::array(std::move(items));
   }
   switch (tag) {
@@ -202,9 +203,14 @@ inline Value decode(Reader& r, int depth = 0) {
     case 0xdb: return Value::str(r.raw(size_t(r.be(4))));
     case 0xdc: case 0xdd: {
       size_t count = (tag == 0xdc) ? size_t(r.be(2)) : size_t(r.be(4));
-      if (count > 1u << 20) { r.ok = false; return Value::nil(); }
+      // every element costs >=1 input byte: a claimed count beyond the
+      // remaining bytes is a spoofed header (a tiny datagram must not be
+      // able to force gigabytes of Value allocation)
+      if (!r.ok || count > r.n - r.off) { r.ok = false; return Value::nil(); }
       ValueVec items;
-      for (size_t i = 0; i < count; ++i) items.push_back(decode(r, depth + 1));
+      for (size_t i = 0; i < count && r.ok; ++i)
+        items.push_back(decode(r, depth + 1));
+      if (!r.ok) return Value::nil();
       return Value::array(std::move(items));
     }
     default:

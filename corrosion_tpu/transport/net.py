@@ -103,9 +103,13 @@ class Transport:
         on_bi_stream: Optional[
             Callable[[Addr, FramedStream], Awaitable[None]]
         ] = None,
+        ssl_server=None,  # ssl.SSLContext for the TCP listener
+        ssl_client=None,  # ssl.SSLContext for outgoing stream connections
     ) -> None:
         self.host = host
         self.port = port
+        self.ssl_server = ssl_server
+        self.ssl_client = ssl_client
         self.on_datagram = on_datagram or (lambda a, d: None)
         self.on_uni_frame = on_uni_frame
         self.on_bi_stream = on_bi_stream
@@ -130,7 +134,7 @@ class Transport:
         )
         udp_port = self._udp.get_extra_info("sockname")[1]
         self._tcp = await asyncio.start_server(
-            self._handle_conn, self.host, udp_port
+            self._handle_conn, self.host, udp_port, ssl=self.ssl_server
         )
         self.port = udp_port
         return (self.host, self.port)
@@ -190,9 +194,16 @@ class Transport:
         if self._udp is not None:
             self._udp.sendto(payload, addr)
 
+    async def _open_stream(self, addr: Addr):
+        if self.ssl_client is not None:
+            return await asyncio.open_connection(
+                *addr, ssl=self.ssl_client, server_hostname=addr[0]
+            )
+        return await asyncio.open_connection(*addr)
+
     async def _connect_uni(self, addr: Addr) -> FramedStream:
         t0 = time.monotonic()
-        reader, writer = await asyncio.open_connection(*addr)
+        reader, writer = await self._open_stream(addr)
         if self.on_rtt is not None:
             self.on_rtt(addr, (time.monotonic() - t0) * 1000.0)
         writer.write(UNI_MAGIC)
@@ -219,7 +230,7 @@ class Transport:
 
     async def open_bi(self, addr: Addr) -> FramedStream:
         t0 = time.monotonic()
-        reader, writer = await asyncio.open_connection(*addr)
+        reader, writer = await self._open_stream(addr)
         if self.on_rtt is not None:
             self.on_rtt(addr, (time.monotonic() - t0) * 1000.0)
         writer.write(BI_MAGIC)

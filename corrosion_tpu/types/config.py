@@ -46,11 +46,30 @@ class ApiConfig:
 
 
 @dataclass
+class GossipTlsConfig:
+    """TLS for the gossip stream channels (ref: config.rs tls section +
+    the rustls setup in api/peer.rs:133-324).  SWIM datagrams stay
+    plaintext — the reference encrypts them only because QUIC does; the
+    stream channels carry the actual data."""
+
+    cert_file: str = ""
+    key_file: str = ""
+    ca_file: Optional[str] = None  # peer CA (verification + client CA)
+    mtls: bool = False  # require client certificates
+    # client identity for mTLS (a clientAuth-EKU cert; server certs carry
+    # only serverAuth and would fail the peer's purpose check)
+    client_cert_file: Optional[str] = None
+    client_key_file: Optional[str] = None
+    insecure: bool = False  # skip server cert verification
+
+
+@dataclass
 class GossipConfig:
     addr: str = "127.0.0.1:0"
     bootstrap: List[str] = field(default_factory=list)
     cluster_id: int = 0
     plaintext: bool = True
+    tls: Optional[GossipTlsConfig] = None
     max_transmissions: int = 15
     probe_period: float = 1.0
     probe_timeout: float = 0.5
@@ -106,8 +125,12 @@ class Config:
                 continue
             target = getattr(cfg, section_field.name)
             for f in fields(target):
-                if f.name in section:
-                    setattr(target, f.name, section[f.name])
+                if f.name not in section:
+                    continue
+                value = section[f.name]
+                if f.name == "tls" and isinstance(value, dict):
+                    value = GossipTlsConfig(**value)
+                setattr(target, f.name, value)
         return cfg
 
 

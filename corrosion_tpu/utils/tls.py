@@ -145,6 +145,51 @@ def generate_client_cert(
     )
 
 
+def server_context(
+    cert_file: str,
+    key_file: str,
+    ca_file: Optional[str] = None,
+    require_client_cert: bool = False,
+):
+    """ssl context for the gossip TCP listener (ref: the rustls server
+    config in api/peer.rs:133-216; mTLS requires a client CA)."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_3
+    ctx.load_cert_chain(cert_file, key_file)
+    if require_client_cert:
+        if ca_file is None:
+            raise ValueError("mTLS requires a client CA file")
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.load_verify_locations(ca_file)
+    return ctx
+
+
+def client_context(
+    ca_file: Optional[str] = None,
+    cert_file: Optional[str] = None,
+    key_file: Optional[str] = None,
+    insecure: bool = False,
+):
+    """ssl context for outgoing gossip connections; ``insecure`` skips
+    verification like the reference's insecure mode."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_3
+    if insecure:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    elif ca_file is not None:
+        ctx.load_verify_locations(ca_file)
+    else:
+        ctx.load_default_certs()
+    if cert_file is not None and key_file is not None:
+        ctx.load_cert_chain(cert_file, key_file)  # mTLS client identity
+    return ctx
+
+
 def write_pair(
     cert_pem: bytes, key_pem: bytes, cert_path: str, key_path: str
 ) -> None:

@@ -1,0 +1,193 @@
+"""TLS gossip-transport tests (ref: the rustls TLS/mTLS/insecure modes of
+the reference transport, api/peer.rs:133-324, and test_mutual_tls,
+peer.rs:1773-1881 — a full handshake with generated certs)."""
+
+import asyncio
+import ssl
+
+import pytest
+from aiohttp import ClientSession
+
+from corrosion_tpu.agent.node import Node
+from corrosion_tpu.client import CorrosionApiClient
+from corrosion_tpu.harness import free_port
+from corrosion_tpu.types.config import Config, GossipTlsConfig
+from corrosion_tpu.types.schema import apply_schema
+from corrosion_tpu.utils import tls as tlsmod
+
+SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, "
+    'text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;'
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """One CA; per-node server certs for 127.0.0.1 + one client cert."""
+    tmp = tmp_path_factory.mktemp("tls")
+    ca_cert, ca_key = tlsmod.generate_ca()
+    paths = {"ca": str(tmp / "ca.pem")}
+    with open(paths["ca"], "wb") as f:
+        f.write(ca_cert)
+    server_cert, server_key = tlsmod.generate_server_cert(
+        ca_cert, ca_key, ["127.0.0.1"]
+    )
+    paths["server_cert"] = str(tmp / "server_cert.pem")
+    paths["server_key"] = str(tmp / "server_key.pem")
+    tlsmod.write_pair(
+        server_cert, server_key, paths["server_cert"], paths["server_key"]
+    )
+    client_cert, client_key = tlsmod.generate_client_cert(ca_cert, ca_key)
+    paths["client_cert"] = str(tmp / "client_cert.pem")
+    paths["client_key"] = str(tmp / "client_key.pem")
+    tlsmod.write_pair(
+        client_cert, client_key, paths["client_cert"], paths["client_key"]
+    )
+    # a second CA nobody trusts
+    evil_cert, evil_key = tlsmod.generate_ca("evil CA")
+    bad_cert, bad_key = tlsmod.generate_server_cert(
+        evil_cert, evil_key, ["127.0.0.1"]
+    )
+    paths["bad_cert"] = str(tmp / "bad_cert.pem")
+    paths["bad_key"] = str(tmp / "bad_key.pem")
+    tlsmod.write_pair(bad_cert, bad_key, paths["bad_cert"], paths["bad_key"])
+    return paths
+
+
+def tls_config(certs, mtls=False, cert="server_cert", key="server_key"):
+    return GossipTlsConfig(
+        cert_file=certs[cert],
+        key_file=certs[key],
+        ca_file=certs["ca"],
+        mtls=mtls,
+        client_cert_file=certs["client_cert"],
+        client_key_file=certs["client_key"],
+    )
+
+
+async def boot_tls(certs, bootstrap=(), mtls=False, **tls_overrides):
+    cfg = Config()
+    cfg.db.path = ":memory:"
+    cfg.gossip.bootstrap = list(bootstrap)
+    cfg.gossip.plaintext = False
+    cfg.gossip.tls = tls_config(certs, mtls=mtls)
+    for k, v in tls_overrides.items():
+        setattr(cfg.gossip.tls, k, v)
+    cfg.gossip.probe_period = 0.3
+    cfg.gossip.probe_timeout = 0.15
+    cfg.perf.sync_interval_min = 0.3
+    cfg.perf.sync_interval_max = 1.0
+    node = await Node(cfg).start()
+    await node.agent.pool.write_call(lambda c: apply_schema(c, SCHEMA))
+    return node
+
+
+async def replicates(n1, n2, timeout=30.0):
+    async with CorrosionApiClient(n1.api_base) as client:
+        await client.execute(
+            [("INSERT INTO tests (id, text) VALUES (?, ?)", (1, "tls"))]
+        )
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        rows = await n2.agent.pool.read_call(
+            lambda c: c.execute("SELECT id, text FROM tests").fetchall()
+        )
+        if rows == [(1, "tls")]:
+            return True
+        if asyncio.get_running_loop().time() > deadline:
+            return False
+        await asyncio.sleep(0.2)
+
+
+def test_tls_cluster_replicates(certs):
+    async def main():
+        n1 = await boot_tls(certs)
+        n2 = await boot_tls(
+            certs, bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"]
+        )
+        try:
+            assert n1.transport.ssl_server is not None
+            assert await replicates(n1, n2)
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())
+
+
+def test_mtls_cluster_replicates(certs):
+    """Full mutual TLS (ref: test_mutual_tls, peer.rs:1773-1881)."""
+
+    async def main():
+        n1 = await boot_tls(certs, mtls=True)
+        n2 = await boot_tls(
+            certs, bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"], mtls=True
+        )
+        try:
+            assert await replicates(n1, n2)
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())
+
+
+def test_plaintext_client_rejected_by_tls_node(certs):
+    async def main():
+        n1 = await boot_tls(certs)
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", n1.gossip_addr[1]
+            )
+            writer.write(b"U" + b"\x00\x00\x00\x01x")
+            await writer.drain()
+            # the TLS server closes a non-TLS stream without serving it
+            data = await asyncio.wait_for(reader.read(64), 5)
+            assert data == b""  # connection dropped
+            writer.close()
+        finally:
+            await n1.stop()
+
+    run(main())
+
+
+def test_mtls_rejects_untrusted_node(certs, tmp_path):
+    """Under mTLS a node whose certs come from an untrusted CA can move
+    data in NEITHER direction: its outbound streams fail n1's client-cert
+    check, and n1's streams to it fail server verification.  (Without
+    mTLS a rogue can still initiate — servers don't verify clients —
+    which is exactly why the reference ships mTLS.)"""
+
+    async def main():
+        # client cert signed by the evil CA
+        evil_ca_cert, evil_ca_key = tlsmod.generate_ca("evil CA")
+        bad_client_cert, bad_client_key = tlsmod.generate_client_cert(
+            evil_ca_cert, evil_ca_key
+        )
+        bad_client = (
+            str(tmp_path / "bad_client_cert.pem"),
+            str(tmp_path / "bad_client_key.pem"),
+        )
+        tlsmod.write_pair(bad_client_cert, bad_client_key, *bad_client)
+
+        n1 = await boot_tls(certs, mtls=True)
+        n2 = await boot_tls(
+            certs,
+            bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"],
+            mtls=True,
+            cert_file=certs["bad_cert"],
+            key_file=certs["bad_key"],
+            client_cert_file=bad_client[0],
+            client_key_file=bad_client[1],
+        )
+        try:
+            assert not await replicates(n1, n2, timeout=6.0)
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())

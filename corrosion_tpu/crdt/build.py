@@ -10,7 +10,6 @@ used so the 3.40 runtime is fine.
 from __future__ import annotations
 
 import os
-import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -42,35 +41,27 @@ def find_lib() -> str:
 
 
 def build(force: bool = False) -> str:
-    """Compile crsqlite.so if missing or stale; return its path."""
-    # strict '>': a git checkout gives source and committed binary the
-    # SAME mtime, which must count as stale (one rebuild re-validates)
-    if (
-        not force
-        and os.path.exists(OUT)
-        and os.path.getmtime(OUT) > os.path.getmtime(SRC)
-    ):
-        return OUT
-    cmd = [
-        "g++",
-        "-std=c++17",
-        "-O2",
-        "-fPIC",
-        "-shared",
-        "-Wall",
-        "-I",
-        find_include(),
-        "-o",
-        OUT,
-        SRC,
-        find_lib(),
-    ]
-    res = subprocess.run(cmd, capture_output=True, text=True)
-    if res.returncode != 0:
-        raise RuntimeError(
-            f"g++ failed building crsqlite.so (exit {res.returncode}):\n{res.stderr}"
-        )
-    return OUT
+    """Compile crsqlite.so if missing or stale (by source hash); return its
+    path.  See utils/nativebuild.py for the staleness + atomicity rules."""
+    from ..utils.nativebuild import build_if_stale
+
+    flags = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall"]
+    # resolved toolchain paths are part of the digest (a relocated SQLite
+    # must trigger a rebuild), but their absence must not break the
+    # cache-hit path on machines that only ever load the prebuilt .so
+    try:
+        inc, lib = find_include(), find_lib()
+        digest_key = "\0".join(flags + [inc, lib])
+    except RuntimeError:
+        inc = lib = None
+        digest_key = "\0".join(flags)
+
+    def make_cmd():
+        i = inc if inc is not None else find_include()  # raises if absent
+        bundled = lib if lib is not None else find_lib()
+        return flags + ["-I", i, "-o", "{tmp}", SRC, bundled]
+
+    return build_if_stale(SRC, OUT, make_cmd, force=force, digest_key=digest_key)
 
 
 if __name__ == "__main__":

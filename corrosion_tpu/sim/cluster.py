@@ -167,6 +167,27 @@ def node_sharding(mesh: Mesh, axis: str = "nodes"):
     return NamedSharding(mesh, P(axis, None))
 
 
+def state_shardings(
+    p: SimParams,
+    mesh: Mesh,
+    node_axis: str = "nodes",
+    change_axis: Optional[str] = None,
+):
+    """Shardings matching ``init_state(p)``'s tuple, leaf by leaf: [N, K]
+    arrays shard (node_axis, change_axis), [N] arrays shard (node_axis,),
+    scalars replicate (None)."""
+    out = []
+    for x in jax.eval_shape(lambda: init_state(p)):
+        ndim = getattr(x, "ndim", 0)
+        if ndim == 2 and x.shape[0] == p.n_nodes:
+            out.append(NamedSharding(mesh, P(node_axis, change_axis)))
+        elif ndim == 1 and x.shape[0] == p.n_nodes:
+            out.append(NamedSharding(mesh, P(node_axis)))
+        else:
+            out.append(None)
+    return tuple(out)
+
+
 def run(
     p: SimParams,
     mesh: Optional[Mesh] = None,

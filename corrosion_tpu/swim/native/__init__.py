@@ -10,11 +10,9 @@ Python-core nodes gossip interchangeably.
 
 from __future__ import annotations
 
-import contextlib
 import ctypes
 import os
 import random
-import subprocess
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -31,34 +29,15 @@ _lib_lock = threading.Lock()
 
 
 def build(force: bool = False) -> str:
-    """Compile libswim.so if missing or stale; return its path.
+    """Compile libswim.so if missing or stale (by source hash); return its
+    path.  See utils/nativebuild.py for the staleness + atomicity rules."""
+    from ...utils.nativebuild import build_if_stale
 
-    Compiles to a temp file and atomically renames into place, so
-    concurrent processes (a SubprocessCluster fanning out nodes on a
-    fresh checkout) never load a half-written library."""
-    # strict '>': a git checkout gives source and committed binary the
-    # SAME mtime, which must count as stale (one rebuild re-validates)
-    if (
-        not force
-        and os.path.exists(OUT)
-        and os.path.getmtime(OUT) > os.path.getmtime(SRC)
-    ):
-        return OUT
-    tmp = OUT + f".tmp.{os.getpid()}"
     cmd = [
         "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall",
-        "-o", tmp, SRC,
+        "-o", "{tmp}", SRC,
     ]
-    res = subprocess.run(cmd, capture_output=True, text=True)
-    if res.returncode != 0:
-        with contextlib.suppress(OSError):
-            os.unlink(tmp)
-        raise RuntimeError(
-            f"g++ failed building libswim.so (exit {res.returncode}):\n"
-            f"{res.stderr}"
-        )
-    os.replace(tmp, OUT)
-    return OUT
+    return build_if_stale(SRC, OUT, cmd, force=force)
 
 
 def load() -> ctypes.CDLL:

@@ -1,0 +1,70 @@
+"""Hash-verified native builds.
+
+Both native components (the CRDT SQLite extension and the SWIM core) are
+compiled on demand from checked-in C++ source into gitignored ``.so``
+files.  Staleness is decided by a content hash of (source bytes, compile
+command) written to a ``<out>.srchash`` sidecar — not mtimes, which lie on
+fresh checkouts (git gives source and any pre-existing binary arbitrary
+relative mtimes).  Output is compiled to a temp path and atomically
+renamed, so concurrent processes (a SubprocessCluster fanning out nodes)
+never load a half-written library.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import subprocess
+from typing import Callable, List, Union
+
+
+def _digest(src: str, key: str) -> str:
+    h = hashlib.sha256()
+    with open(src, "rb") as f:
+        h.update(f.read())
+    h.update(key.encode())
+    return h.hexdigest()
+
+
+def build_if_stale(
+    src: str,
+    out: str,
+    cmd: Union[List[str], Callable[[], List[str]]],
+    force: bool = False,
+    digest_key: str = "",
+) -> str:
+    """Run ``cmd`` (which must write to ``{tmp}``) unless ``out`` already
+    matches the current (source, flags) digest; return ``out``.
+
+    ``cmd`` is the compiler argv with the literal placeholder ``"{tmp}"``
+    where the output path goes — or a zero-arg callable returning it, for
+    builds whose argv needs toolchain discovery (header/library probing)
+    that must not run on the cache-hit path.  The digest covers the source
+    bytes plus ``digest_key`` (pass the stable flag set when ``cmd`` is a
+    callable; a list cmd is its own key).
+    """
+    sidecar = out + ".srchash"
+    key = digest_key if callable(cmd) else "\0".join(cmd)
+    digest = _digest(src, key)
+    if not force and os.path.exists(out):
+        with contextlib.suppress(OSError):
+            with open(sidecar) as f:
+                if f.read().strip() == digest:
+                    return out
+    tmp = out + f".tmp.{os.getpid()}"
+    argv = [tmp if a == "{tmp}" else a for a in (cmd() if callable(cmd) else cmd)]
+    res = subprocess.run(argv, capture_output=True, text=True)
+    if res.returncode != 0:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise RuntimeError(
+            f"native build failed (exit {res.returncode}): {os.path.basename(src)}\n"
+            f"{res.stderr}"
+        )
+    os.replace(tmp, out)
+    sidecar_tmp = sidecar + f".tmp.{os.getpid()}"
+    with open(sidecar_tmp, "w") as f:
+        f.write(digest + "\n")
+    os.replace(sidecar_tmp, sidecar)
+    return out

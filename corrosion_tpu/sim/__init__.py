@@ -7,11 +7,13 @@ fanout/sync as scatter-max/gather, sharded over a device mesh.
 
 - rng:       counter-based PRNG, bit-identical Python/JAX streams
 - model:     round-synchronous cluster model + BASELINE configs 1-5
-- reference: pure-Python per-node CPU reference simulator
+- reference: pure-Python per-node scalar mirror of the round model
 - cluster:   vectorized JAX simulator (the TPU compute path)
+- sync:      anti-entropy needs algebra as coverage-bitmask operations
 - crdt:      vectorized LWW/causal-length merge analysis
 """
 
 from .model import CONFIGS, SimParams  # noqa: F401
 from .cluster import SimResult, init_state, make_step, run, run_trace  # noqa: F401
 from .reference import RefResult, run_reference  # noqa: F401
+from . import sync  # noqa: F401

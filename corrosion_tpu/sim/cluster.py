@@ -1,18 +1,26 @@
 """Vectorized TPU cluster simulator (JAX).
 
-The whole cluster is one tensor program: node state is a pair of arrays
+The whole cluster is one tensor program: node state is
 
-  ``have``   bool[N, K]   node n holds changeset k
+  ``cov``    uint8[N, K]  chunk-coverage bitmask of changeset k at node n
+                          (seq-range reassembly as boolean coverage masks,
+                          SURVEY.md §5; complete ⇔ cov == full_mask[k])
   ``budget`` int8[N, K]   remaining retransmissions (broadcast send_count,
                           ref: PendingBroadcast, broadcast/mod.rs:747-773)
+  ``status`` int8[2, N]   SWIM membership view per partition side
+                          (ALIVE/SUSPECT/DOWN — the foca state machine
+                          driven by broadcast/mod.rs:162-374, vectorized)
+  ``since``  int32[2, N]  round of the last status transition (suspicion
+                          timers + rejoin lag as round counters)
 
 and one gossip round (sim/model.py's round model) is one pure ``step``
 suitable for ``lax.while_loop`` / ``lax.scan``.  Dissemination is
-edge-scatter: each fanout slot is a row-scatter ``delivered.at[t].max(pay)``
-(duplicate targets OR-combine), anti-entropy is a row-gather
-``have[q]``.  All randomness is the counter-based integer hash of
-sim/rng.py, bit-identical to the CPU reference (sim/reference.py), so
-round counts agree exactly.
+edge-scatter: each (fanout, chunk) slot is a row-scatter
+``delivered.at[t].max(bit)`` (duplicate targets OR-combine); anti-entropy
+is a row-gather ``cov[q]`` filtered through the bitmap needs algebra of
+sim/sync.py and a per-session chunk budget.  All randomness is the
+counter-based integer hash of sim/rng.py, bit-identical to the CPU
+reference (sim/reference.py), so round counts agree exactly.
 
 Scaling: shard the node axis across a ``jax.sharding.Mesh`` —
 ``run(p, mesh=...)`` places state with ``NamedSharding(P('nodes', None))``
@@ -20,9 +28,10 @@ and jits the full loop; GSPMD turns the cross-shard scatters/gathers into
 ICI collectives.  No data-dependent Python control flow: convergence is the
 ``while_loop`` predicate, computed on-device.
 
-Fidelity contract with the reference simulator is enforced by
-tests/test_sim.py (exact round-count equality on all five BASELINE
-configs, small sizes).
+Fidelity contract with the scalar mirror is enforced by tests/test_sim.py
+(exact round-count and state equality on all five BASELINE configs, small
+sizes); fidelity against the real agent runtime (independent RNG and
+implementation) by tests/test_sim_vs_harness.py.
 """
 
 from __future__ import annotations
@@ -37,19 +46,22 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .model import COMPLETE, ER, POWERLAW, SimParams
+from .model import ALIVE, COMPLETE, DOWN, ER, POWERLAW, SUSPECT, SimParams
 from .rng import (
     TAG_BCAST,
     TAG_CHURN,
     TAG_INJECT,
     TAG_ORIGIN,
     TAG_PART,
+    TAG_PROBE,
     TAG_SYNC,
     TAG_TOPO,
     jx_below,
 )
+from . import sync as syncmod
 
-SimState = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # (have, budget, round)
+# (cov, budget, status, since, round)
+SimState = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
 
 
 @dataclass
@@ -59,7 +71,7 @@ class SimResult:
     wall_s: float
     compile_s: float = 0.0
     coverage: List[float] = field(default_factory=list)
-    state: Optional[SimState] = None  # final (have, budget, r) if requested
+    state: Optional[SimState] = None  # final state if requested
 
 
 def _consts(p: SimParams):
@@ -75,28 +87,75 @@ def _consts(p: SimParams):
 
 
 def init_state(p: SimParams) -> SimState:
-    have = jnp.zeros((p.n_nodes, p.n_changes), dtype=bool)
+    cov = jnp.zeros((p.n_nodes, p.n_changes), dtype=jnp.uint8)
     budget = jnp.zeros((p.n_nodes, p.n_changes), dtype=jnp.int8)
-    return have, budget, jnp.int32(0)
+    status = jnp.full((2, p.n_nodes), ALIVE, dtype=jnp.int8)
+    since = jnp.zeros((2, p.n_nodes), dtype=jnp.int32)
+    return cov, budget, status, since, jnp.int32(0)
+
+
+def complete_mask(state_cov: jnp.ndarray, p: SimParams) -> jnp.ndarray:
+    """bool[N, K]: which changesets are fully assembled at each node."""
+    full = jnp.asarray(syncmod.full_masks(p))
+    return state_cov == full[None, :]
 
 
 def make_step(p: SimParams):
     """Build the jittable one-round transition for params ``p``."""
-    N, K = p.n_nodes, p.n_changes
+    N, K, S = p.n_nodes, p.n_changes, max(1, p.nseq_max)
     T8 = jnp.int8(p.max_transmissions)
+    D = p.churn_down_rounds
     origin, inject_round, part = _consts(p)
     narange = jnp.arange(N, dtype=jnp.int32)
     karange = jnp.arange(K, dtype=jnp.int32)
+    full = jnp.asarray(syncmod.full_masks(p))
+    aidx, vidx, n_actors = syncmod.actor_index(p)
+    attempts = p.swim_probe_attempts if p.swim else 1
 
-    def bcast_target(r, j: int):
-        """Mirror of reference._bcast_target, vectorized over nodes."""
+    def death(x):
+        """bool[N]: churn death draw hit at round x (x may be negative)."""
+        hit = jx_below(1_000_000, p.seed, TAG_CHURN, x, narange) < p.churn_ppm
+        in_window = jnp.logical_and(x >= 0, x < p.churn_rounds)
+        return jnp.logical_and(hit, in_window)
+
+    def alive_at(r):
+        """bool[N]: ground-truth liveness during round r (a death at round
+        x makes the node unresponsive for rounds x+1 .. x+D)."""
+        if p.churn_ppm == 0 or p.churn_rounds == 0 or D == 0:
+            return jnp.ones((N,), dtype=bool)
+        a = jnp.ones((N,), dtype=bool)
+        for d in range(1, D + 1):
+            a = jnp.logical_and(a, jnp.logical_not(death(r - d)))
+        return a
+
+    def draw_excluding(down2, view, draw_fn):
+        """First candidate (over ``attempts`` redraws) not believed down
+        by its chooser — ``down2[v, t]`` is side-v's view of t, and node n
+        consults its OWN side's view ``down2[view[n], t]``; returns
+        (target[N], found[N])."""
+        t = draw_fn(0)
+        ok = jnp.logical_not(down2[view, t])
+        for a in range(1, attempts):
+            cand = draw_fn(a)
+            take = jnp.logical_and(
+                jnp.logical_not(ok), jnp.logical_not(down2[view, cand])
+            )
+            t = jnp.where(take, cand, t)
+            ok = jnp.logical_or(ok, take)
+        return t, ok
+
+    def bcast_target(r, slot: int, a: int):
+        """Fanout target per node for (round, slot, attempt) — mirrors
+        reference._bcast_target."""
+        suffix = () if a == 0 else (a,)
         if p.topology == ER:
-            i = jx_below(p.er_degree, p.seed, TAG_BCAST, r, narange, j)
+            i = jx_below(p.er_degree, p.seed, TAG_BCAST, r, narange, slot, *suffix)
             t = jx_below(N - 1, p.seed, TAG_TOPO, narange, i)
         elif p.topology == POWERLAW:
             draws = [
                 jx_below(
-                    N - 1, p.seed, TAG_BCAST, r, narange, j * p.powerlaw_gamma + g
+                    N - 1, p.seed, TAG_BCAST, r, narange,
+                    slot * p.powerlaw_gamma + g, *suffix,
                 )
                 for g in range(p.powerlaw_gamma)
             ]
@@ -105,60 +164,179 @@ def make_step(p: SimParams):
                 t = jnp.minimum(t, d)
         else:
             assert p.topology == COMPLETE
-            t = jx_below(N - 1, p.seed, TAG_BCAST, r, narange, j)
+            t = jx_below(N - 1, p.seed, TAG_BCAST, r, narange, slot, *suffix)
         return t + (t >= narange)  # skip self
 
     def step(state: SimState) -> SimState:
-        have, budget, r = state
-        # 1. inject this round's writes at their origins
-        inj = inject_round == r
-        have = have.at[origin, karange].max(inj)
-        budget = budget.at[origin, karange].max(jnp.where(inj, T8, jnp.int8(0)))
+        cov, budget, status, since, r = state
+        alive = alive_at(r)
+        restarted = jnp.logical_and(alive, jnp.logical_not(alive_at(r - 1)))
         # effective partition side (all-zero once healed)
-        pvec = jnp.where(r < p.partition_rounds, part, jnp.int8(0))
-        # 2. broadcast whole pending payloads to fanout targets
-        pend = budget > 0
-        delivered = jnp.zeros_like(have)
-        for j in range(p.fanout):
-            t = bcast_target(r, j)
-            ok = pvec == pvec[t]
-            delivered = delivered.at[t].max(pend & ok[:, None])
-        # 3. merge + budget bookkeeping (fresh budget ⇒ rebroadcast)
-        new = delivered & ~have
-        have = have | delivered
-        budget = jnp.where(
-            new, T8, jnp.where(pend, budget - jnp.int8(1), budget)
+        part_active = r < p.partition_rounds
+        pvec = jnp.where(part_active, part, jnp.int8(0))
+        view = part.astype(jnp.int32)  # static side label = viewer's view
+
+        # 1. inject this round's writes at their origins, full coverage
+        inj = inject_round == r
+        cov = cov.at[origin, karange].max(
+            jnp.where(inj, full[karange], jnp.uint8(0))
         )
-        # 4. anti-entropy: simultaneous pull of one peer's full state
+        budget = budget.at[origin, karange].max(jnp.where(inj, T8, jnp.int8(0)))
+
+        # 2. SWIM probe / suspect / refute / rejoin (per-side views)
+        if p.swim:
+            down2 = status == DOWN  # [2, N] believed-down per side view
+
+            def probe_draw(a):
+                suffix = () if a == 0 else (a,)
+                t = jx_below(N - 1, p.seed, TAG_PROBE, r, narange, *suffix)
+                return t + (t >= narange)
+
+            target, found = draw_excluding(down2, view, probe_draw)
+            link_ok = pvec == pvec[target]
+            probing = jnp.logical_and(alive, found)
+            succ_probe = jnp.logical_and(probing, jnp.logical_and(alive[target], link_ok))
+            fail_probe = jnp.logical_and(probing, jnp.logical_not(jnp.logical_and(alive[target], link_ok)))
+
+            new_status, new_since = [], []
+            for v in range(2):
+                st_v, si_v = status[v], since[v]
+                # probes update the prober's side view while partitioned,
+                # both views otherwise (piggyback = global dissemination)
+                upd = jnp.where(part_active, part == v, True)
+                succ_v = (
+                    jnp.zeros((N,), bool)
+                    .at[target]
+                    .max(jnp.logical_and(succ_probe, upd))
+                )
+                fail_v = (
+                    jnp.zeros((N,), bool)
+                    .at[target]
+                    .max(jnp.logical_and(fail_probe, upd))
+                )
+                # suspicion expiry first (timer from previous rounds)
+                expire = jnp.logical_and(
+                    st_v == SUSPECT, r - si_v >= p.swim_suspicion_rounds
+                )
+                st2 = jnp.where(expire, jnp.int8(DOWN), st_v)
+                si2 = jnp.where(expire, r, si_v)
+                # failed probes: alive → suspect (or straight down)
+                fail_to = jnp.int8(SUSPECT if p.swim_suspicion else DOWN)
+                hit = jnp.logical_and(fail_v, st2 == ALIVE)
+                st2 = jnp.where(hit, fail_to, st2)
+                si2 = jnp.where(hit, r, si2)
+                # successful probes refute (incarnation-bump alive update)
+                ref = jnp.logical_and(succ_v, st2 != ALIVE)
+                st2 = jnp.where(ref, jnp.int8(ALIVE), st2)
+                si2 = jnp.where(ref, r, si2)
+                # announce: restarts now; down-marked live nodes after the
+                # rejoin lag — reachable views only
+                reach = jnp.where(part_active, part == jnp.int8(v), True)
+                ann = jnp.logical_and(
+                    reach,
+                    jnp.logical_or(
+                        jnp.logical_and(restarted, st2 != ALIVE),
+                        jnp.logical_and(
+                            jnp.logical_and(alive, st2 == DOWN),
+                            r - si2 >= p.swim_rejoin_rounds,
+                        ),
+                    ),
+                )
+                st2 = jnp.where(ann, jnp.int8(ALIVE), st2)
+                si2 = jnp.where(ann, r, si2)
+                new_status.append(st2)
+                new_since.append(si2)
+            status = jnp.stack(new_status)
+            since = jnp.stack(new_since)
+            down2 = status == DOWN
+        else:
+            down2 = jnp.zeros((2, N), dtype=bool)
+
+        # 3. broadcast: each held chunk of each budgeted changeset is
+        # independently fanned out (chunked payloads take distinct paths);
+        # one boolean scatter plane per chunk bit (a max over mixed bit
+        # values would drop bits — OR semantics needed)
+        pend = jnp.logical_and(budget > 0, alive[:, None])
+        delivered = jnp.zeros_like(cov)
+        for s in range(S):
+            bit = jnp.uint8(1 << s)
+            plane = jnp.zeros((N, K), dtype=bool)
+            for j in range(p.fanout):
+                slot = j * S + s
+                t, found = draw_excluding(
+                    down2, view, lambda a, slot=slot: bcast_target(r, slot, a)
+                )
+                ok = jnp.logical_and(
+                    jnp.logical_and(found, pvec == pvec[t]), alive[t]
+                )
+                pay = (
+                    jnp.logical_and(pend, (cov & bit).astype(bool))
+                    & ok[:, None]
+                )
+                plane = plane.at[t].max(pay)
+            delivered = delivered | jnp.where(plane, bit, jnp.uint8(0))
+
+        # 4. receive: accumulate chunks, refresh budgets on new coverage
+        new_bits = delivered & ~cov
+        new_bits = jnp.where(alive[:, None], new_bits, 0)
+        cov = cov | new_bits
+        budget = jnp.where(
+            new_bits != 0,
+            T8,
+            jnp.where(pend, budget - jnp.int8(1), budget),
+        )
+
+        # 5. anti-entropy: budgeted needs-based pull from one peer
         if p.sync_interval > 0:
-            q = jx_below(N - 1, p.seed, TAG_SYNC, r, narange)
-            q = q + (q >= narange)
-            okq = pvec == pvec[q]
-            pulled = have[q] & okq[:, None]
-            do = ((r + 1) % p.sync_interval) == 0
-            have = jnp.where(do, have | pulled, have)
-        # 5. churn: hash-selected restarts keep only their own writes
+
+            def sync_draw(a):
+                suffix = () if a == 0 else (a,)
+                q = jx_below(N - 1, p.seed, TAG_SYNC, r, narange, *suffix)
+                return q + (q >= narange)
+
+            q, found = draw_excluding(down2, view, sync_draw)
+            okq = jnp.logical_and(
+                jnp.logical_and(found, pvec == pvec[q]),
+                jnp.logical_and(alive, alive[q]),
+            )
+            heads_mine = syncmod.jx_heads(cov, aidx, vidx, n_actors)
+            avail = syncmod.jx_available(
+                cov, cov[q], full, heads_mine, aidx, vidx
+            )
+            pulled = syncmod.jx_budget_transfer(avail, p.sync_chunk_budget)
+            do = jnp.logical_and((r + 1) % p.sync_interval == 0, okq)
+            cov = jnp.where(do[:, None], cov | pulled, cov)
+
+        # 6. churn: hash-selected deaths wipe to own writes (replacement
+        # node re-registering); the node stays unresponsive for D rounds
         if p.churn_ppm > 0 and p.churn_rounds > 0:
-            draw = jx_below(1_000_000, p.seed, TAG_CHURN, r, narange)
-            restart = (draw < p.churn_ppm) & (r < p.churn_rounds)
-            own = (origin[None, :] == narange[:, None]) & (
-                inject_round[None, :] <= r
-            )
-            have = jnp.where(restart[:, None], own, have)
+            die = death(r)
+            # own[n, k]: changeset k originates at n (restart survivors);
+            # computed in-step so it fuses instead of sitting as an [N, K]
+            # constant in the executable
+            own = origin[None, :] == narange[:, None]
+            own_now = jnp.logical_and(own, inject_round[None, :] <= r)
+            own_cov = jnp.where(own_now, full[None, :], 0).astype(jnp.uint8)
+            cov = jnp.where(die[:, None], own_cov, cov)
             budget = jnp.where(
-                restart[:, None], jnp.where(own, T8, jnp.int8(0)), budget
+                die[:, None],
+                jnp.where(own_now, T8, jnp.int8(0)),
+                budget,
             )
-        return have, budget, r + 1
+        return cov, budget, status, since, r + 1
 
     return step
 
 
 def _run_loop(p: SimParams, state: SimState) -> SimState:
     step = make_step(p)
+    full = jnp.asarray(syncmod.full_masks(p))
 
     def cond(state):
-        have, _, r = state
-        return jnp.logical_and(~have.all(), r < p.max_rounds)
+        cov = state[0]
+        r = state[-1]
+        done = (cov == full[None, :]).all()
+        return jnp.logical_and(~done, r < p.max_rounds)
 
     return lax.while_loop(cond, lambda s: step(s), state)
 
@@ -175,7 +353,8 @@ def state_shardings(
 ):
     """Shardings matching ``init_state(p)``'s tuple, leaf by leaf: [N, K]
     arrays shard (node_axis, change_axis), [N] arrays shard (node_axis,),
-    scalars replicate (None)."""
+    anything else — the [2, N] membership views, the scalar round counter —
+    replicates (None)."""
     out = []
     for x in jax.eval_shape(lambda: init_state(p)):
         ndim = getattr(x, "ndim", 0)
@@ -199,53 +378,56 @@ def run(
     compile both (BASELINE.md reports wall-clock)."""
     state = init_state(p)
     if mesh is not None:
-        sh = node_sharding(mesh, mesh_axis)
-        state = (
-            jax.device_put(state[0], sh),
-            jax.device_put(state[1], sh),
-            state[2],
+        shardings = state_shardings(p, mesh, node_axis=mesh_axis)
+        state = tuple(
+            x if s is None else jax.device_put(x, s)
+            for x, s in zip(state, shardings)
         )
         fn = jax.jit(
             partial(_run_loop, p),
-            in_shardings=((sh, sh, None),),
-            out_shardings=(sh, sh, None),
+            in_shardings=(shardings,),
+            out_shardings=shardings,
         )
     else:
         fn = jax.jit(partial(_run_loop, p))
     t0 = time.perf_counter()
     compiled = fn.lower(state).compile()
     t1 = time.perf_counter()
-    have, budget, r = jax.block_until_ready(compiled(state))
+    out = jax.block_until_ready(compiled(state))
     t2 = time.perf_counter()
+    cov, r = out[0], out[-1]
+    converged = bool((cov == jnp.asarray(syncmod.full_masks(p))[None, :]).all())
     return SimResult(
-        converged=bool(have.all()),
+        converged=converged,
         rounds=int(r),
         wall_s=t2 - t1,
         compile_s=t1 - t0,
-        state=(have, budget, r) if return_state else None,
+        state=tuple(out) if return_state else None,
     )
 
 
 def run_trace(p: SimParams, n_rounds: Optional[int] = None) -> SimResult:
-    """Fixed-round scan recording per-round coverage (analysis mode)."""
+    """Fixed-round scan recording per-round complete-coverage (analysis)."""
     n_rounds = p.max_rounds if n_rounds is None else n_rounds
     step = make_step(p)
+    full = jnp.asarray(syncmod.full_masks(p))
 
     def body(state, _):
         state = step(state)
-        return state, state[0].sum()
+        return state, (state[0] == full[None, :]).sum()
 
     t0 = time.perf_counter()
-    (have, _, r), counts = jax.block_until_ready(
+    out, counts = jax.block_until_ready(
         jax.jit(lambda s: lax.scan(body, s, None, length=n_rounds))(init_state(p))
     )
     t1 = time.perf_counter()
+    cov = out[0]
     total = p.n_nodes * p.n_changes
     coverage = [int(c) / total for c in counts]
-    full = [i for i, c in enumerate(counts) if int(c) == total]
+    full_rounds = [i for i, c in enumerate(counts) if int(c) == total]
     return SimResult(
-        converged=bool(have.all()),
-        rounds=(full[0] + 1) if full else n_rounds,
+        converged=bool((cov == full[None, :]).all()),
+        rounds=(full_rounds[0] + 1) if full_rounds else n_rounds,
         wall_s=t1 - t0,
         coverage=coverage,
     )

@@ -10,34 +10,49 @@ calls for.  Per round, in order:
 
 1. *Inject*: changesets scheduled for this round appear at their origin
    node with a full retransmission budget (ref: local commit →
-   `make_broadcastable_changes`, api/public/mod.rs:39-242).
-2. *Broadcast*: every node with a non-empty pending set (budget > 0)
-   batches ALL pending changesets into one payload (ref: the broadcast
-   loop drains its queue into ≤64 KiB payloads, broadcast/mod.rs:377) and
-   sends it to `fanout` targets drawn from its topology neighbors
-   (ref: ring0 + random members, broadcast/mod.rs:488-547).  Deliveries
-   to dead nodes or across an active partition are lost.
-3. *Receive*: newly-seen changesets get a fresh budget of
-   `max_transmissions` (rebroadcast of unseen broadcast-sourced changes,
-   handlers.rs:530-538); senders decrement budgets by 1 (send_count,
-   broadcast/mod.rs:747-773).
-4. *Anti-entropy* (every `sync_interval` rounds): each node pulls the full
-   state of one random peer — the round-synchronous collapse of
-   generate_sync → compute_available_needs → chunked transfer
-   (api/peer.rs:921-1296).  Sync-sourced changes are NOT rebroadcast,
-   matching ChangeSource::Sync handling (handlers.rs:530).
-5. *Churn*: a hash-selected fraction of nodes restarts empty except for
-   its own already-written changesets (a replacement node re-registering
-   its local state — the Fly.io service-discovery pattern), recovering
-   the rest via anti-entropy.
-6. *Partition*: for the first `partition_rounds` rounds, nodes are split
+   `make_broadcastable_changes`, api/public/mod.rs:39-242).  A changeset
+   has ``1..nseq_max`` seq-chunks (ref: ChunkedChanges 8 KiB chunking,
+   change.rs:8-116); the origin holds all of them.
+2. *SWIM* (when ``swim`` is on): every live node probes one member it
+   believes up (hashed target, ``swim_probe_attempts`` redraws around
+   believed-down entries).  Failed probes drive the foca state machine
+   abstraction: alive → suspect (``swim_suspicion``) → down after
+   ``swim_suspicion_rounds``, or straight to down with suspicion off;
+   successful probes refute; nodes found down while actually alive
+   re-announce after ``swim_rejoin_rounds`` (ref: foca probe/suspect
+   cycle driven by broadcast/mod.rs:162-374; auto-rejoin via
+   Identity::renew, actor.rs:199-210).  Membership views are tracked per
+   partition side (each side independently suspects the other).
+3. *Broadcast*: every live node with budgeted chunks sends each held
+   chunk to ``fanout`` targets it believes up — each chunk payload is
+   fanned out independently, the round model of one version's chunked
+   payloads taking different gossip paths (broadcast/mod.rs:377-599).
+   Deliveries to dead nodes or across an active partition are lost.
+4. *Receive*: chunks landing on a live node accumulate in its coverage
+   mask (partial buffering, util.rs:1392-1511); any new chunk refreshes
+   that changeset's budget to ``max_transmissions`` (rebroadcast of
+   unseen broadcast-sourced changes, handlers.rs:530-538); senders
+   decrement budgets by 1 (send_count, broadcast/mod.rs:747-773).
+5. *Anti-entropy* (every `sync_interval` rounds): each live node pulls
+   from one believed-up peer the chunks the peer can serve under the
+   reference's needs algebra — above-head versions fully, gap versions
+   only if the peer has them complete, partial versions seq-wise
+   (sync.rs:125-247, vectorized in sim/sync.py), capped at
+   ``sync_chunk_budget`` chunks per session (0 = uncapped).  Sync-sourced
+   chunks are NOT rebroadcast (ChangeSource::Sync, handlers.rs:530).
+6. *Churn*: a hash-selected fraction of nodes dies, is unresponsive for
+   ``churn_down_rounds`` rounds, then restarts holding only its own
+   already-written changesets (a replacement node re-registering its
+   local state — the Fly.io service-discovery pattern), recovering the
+   rest via anti-entropy.  ``churn_down_rounds=0`` restarts instantly.
+7. *Partition*: for the first `partition_rounds` rounds, nodes are split
    into two sides (30%/70% in BASELINE config 5) and all traffic between
    sides is dropped; afterwards the partition heals.
 
 Convergence (the metric in BENCH output) = first round at the end of which
-**every node holds every injected changeset** — the tensor form of the
-reference's convergence bar "all rows everywhere AND need_len()==0 on every
-node" (crates/corro-agent/src/agent/tests.rs:464-476).
+**every node holds every chunk of every injected changeset** — the tensor
+form of the reference's convergence bar "all rows everywhere AND
+need_len()==0 on every node" (crates/corro-agent/src/agent/tests.rs:464-476).
 
 Topology: `complete` samples fanout targets uniformly from all-but-self;
 `er` precomputes a directed Erdős–Rényi out-neighbor table of degree
@@ -51,6 +66,10 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 
 COMPLETE, ER, POWERLAW = "complete", "er", "powerlaw"
+
+# SWIM membership view states (cluster-consensus abstraction of foca's
+# per-member Alive/Suspect/Down, broadcast/mod.rs:162-374)
+ALIVE, SUSPECT, DOWN = 0, 1, 2
 
 
 @dataclass(frozen=True)
@@ -69,8 +88,18 @@ class SimParams:
     powerlaw_gamma: int = 3  # hub bias for topology == "powerlaw"
     churn_ppm: int = 0  # per-round per-node restart prob, parts/million
     churn_rounds: int = 0  # churn active during rounds [0, churn_rounds)
+    churn_down_rounds: int = 0  # rounds a churned node stays unresponsive
     partition_frac_ppm: int = 0  # fraction of nodes on side B, ppm
     partition_rounds: int = 0  # partition active during rounds [0, ..)
+    # SWIM membership modeling (step 2 above); off = all-alive static view
+    swim: bool = False
+    swim_suspicion: bool = True  # alive→suspect→down vs alive→down
+    swim_suspicion_rounds: int = 3  # suspect rounds before declared down
+    swim_probe_attempts: int = 3  # redraws around believed-down targets
+    swim_rejoin_rounds: int = 2  # rounds before a down-marked live node re-announces
+    # seq-chunking + sync needs budget (steps 1/5 above)
+    nseq_max: int = 1  # chunks per changeset in [1, nseq_max]; 1 = unchunked
+    sync_chunk_budget: int = 0  # max chunks served per sync session; 0 = all
     seed: int = 0
 
     def with_(self, **kw) -> "SimParams":
@@ -87,7 +116,8 @@ def config1_ring3(seed: int = 0) -> SimParams:
 
 
 def config2_er1k(seed: int = 0) -> SimParams:
-    """1k-node Erdős–Rényi, pure push gossip (no anti-entropy).
+    """1k-node Erdős–Rényi, pure push gossip (no anti-entropy), SWIM with
+    suspicion disabled (BASELINE config 2: "suspicion+piggyback disabled").
 
     Push-only dissemination has no repair path, so the retransmission
     budget is raised vs the anti-entropy configs: with out-degree 10,
@@ -97,34 +127,43 @@ def config2_er1k(seed: int = 0) -> SimParams:
     return SimParams(
         n_nodes=1000, n_changes=64, fanout=3, max_transmissions=6,
         sync_interval=0, write_rounds=4, max_rounds=256,
-        topology=ER, er_degree=10, seed=seed,
+        topology=ER, er_degree=10,
+        swim=True, swim_suspicion=False, seed=seed,
     )
 
 
 def config3_powerlaw10k(seed: int = 0) -> SimParams:
-    """10k-node power-law mesh, full gossip + anti-entropy."""
+    """10k-node power-law mesh, full SWIM failure detection + anti-entropy
+    with seq-chunked changesets and budgeted needs-based sync."""
     return SimParams(
         n_nodes=10_000, n_changes=128, fanout=3, max_transmissions=3,
         sync_interval=5, write_rounds=8, max_rounds=512,
-        topology=POWERLAW, powerlaw_gamma=3, seed=seed,
+        topology=POWERLAW, powerlaw_gamma=3,
+        swim=True, swim_suspicion=True,
+        nseq_max=4, sync_chunk_budget=64, seed=seed,
     )
 
 
 def config4_churn100k(seed: int = 0) -> SimParams:
-    """100k-node multi-table with churn: 5%/round for 20 rounds."""
+    """100k-node multi-table with churn: 5%/round for 20 rounds, nodes
+    unresponsive for 3 rounds before their replacement re-registers; full
+    SWIM so dead nodes get suspected and excluded from fanout."""
     return SimParams(
         n_nodes=100_000, n_changes=512, fanout=3, max_transmissions=3,
         sync_interval=5, write_rounds=16, max_rounds=512,
-        churn_ppm=50_000, churn_rounds=20, seed=seed,
+        churn_ppm=50_000, churn_rounds=20, churn_down_rounds=3,
+        swim=True, swim_suspicion=True, seed=seed,
     )
 
 
 def config5_partition100k(seed: int = 0) -> SimParams:
-    """100k nodes, 30% partitioned for 50 rounds, then heal."""
+    """100k nodes, 30% partitioned for 50 rounds, then heal; full SWIM —
+    each side suspects the other down, then refutes after the heal."""
     return SimParams(
         n_nodes=100_000, n_changes=512, fanout=3, max_transmissions=3,
         sync_interval=5, write_rounds=16, max_rounds=512,
-        partition_frac_ppm=300_000, partition_rounds=50, seed=seed,
+        partition_frac_ppm=300_000, partition_rounds=50,
+        swim=True, swim_suspicion=True, seed=seed,
     )
 
 

@@ -1,35 +1,42 @@
-"""Pure-Python per-node CPU reference simulator.
+"""Pure-Python per-node scalar mirror of the round model.
 
-This is the scalar, object-per-node implementation of the round model in
+This is the object-per-node implementation of the round model in
 :mod:`corrosion_tpu.sim.model` — the executable spec the vectorized TPU
-simulator (:mod:`corrosion_tpu.sim.cluster`) is validated against, playing
-the role BASELINE.md assigns to the `corro-devcluster`-equivalent CPU
-harness.  Because every random decision is the shared counter-based hash
-(sim/rng.py), round counts here and on TPU agree **bit-for-bit**; the
-`vs CPU reference ±2%` bar is met with 0% divergence by construction
-(asserted by tests/test_sim.py across all five BASELINE configs).
+simulator (:mod:`corrosion_tpu.sim.cluster`) is checked against for
+implementation typos.  Because every random decision is the shared
+counter-based hash (sim/rng.py), state here and on TPU agrees
+**bit-for-bit** (asserted by tests/test_sim.py).
 
-State per node is a plain ``set`` of changeset ids plus a budget dict —
-deliberately naive so the semantics stay legible; use the JAX backend for
-anything beyond a few thousand nodes.
+Note what this is and is not: the shared-RNG equality proves the tensor
+program implements the same round model, not that the round model is
+faithful to Corrosion — fidelity against the real agent runtime (its own
+RNG, timers, and wire protocol) is measured separately by
+tests/test_sim_vs_harness.py against the in-process DevCluster harness.
+
+State per node is an int coverage bitmask per changeset plus a budget
+list, SWIM views as two per-side status/since lists — deliberately naive
+so the semantics stay legible; use the JAX backend for anything beyond a
+few thousand nodes.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import List, Optional, Set
 
-from .model import COMPLETE, ER, POWERLAW, SimParams
+from .model import ALIVE, COMPLETE, DOWN, ER, POWERLAW, SUSPECT, SimParams
 from .rng import (
     TAG_BCAST,
     TAG_CHURN,
     TAG_INJECT,
     TAG_ORIGIN,
     TAG_PART,
+    TAG_PROBE,
     TAG_SYNC,
     TAG_TOPO,
     py_below,
 )
+from . import sync as syncmod
 
 
 @dataclass
@@ -37,34 +44,54 @@ class RefResult:
     converged: bool
     rounds: int  # rounds executed until convergence (or max_rounds)
     coverage: List[float] = field(default_factory=list)  # per-round fill
-    # final per-node have-sets, for exact state comparison with the JAX sim
+    # final per-node COMPLETE-changeset sets, for comparison with the sim
     have: List[Set[int]] = field(default_factory=list)
+    # final per-node coverage bitmasks (chunk-level state)
+    cov: List[List[int]] = field(default_factory=list)
+    # final membership views [2][N]
+    status: List[List[int]] = field(default_factory=list)
+    # final per-node retransmission budgets (debugging / state equality)
+    budget: List[List[int]] = field(default_factory=list)
 
 
-def _bcast_target(p: SimParams, r: int, n: int, j: int) -> int:
-    """Fanout target for (round, node, slot) — must mirror sim.cluster."""
+def _bcast_target(p: SimParams, r: int, n: int, slot: int, a: int) -> int:
+    """Fanout target for (round, node, slot, attempt) — mirrors
+    sim.cluster.bcast_target."""
+    suffix = () if a == 0 else (a,)
     if p.topology == ER:
-        i = py_below(p.er_degree, p.seed, TAG_BCAST, r, n, j)
+        i = py_below(p.er_degree, p.seed, TAG_BCAST, r, n, slot, *suffix)
         t = py_below(p.n_nodes - 1, p.seed, TAG_TOPO, n, i)
     elif p.topology == POWERLAW:
         t = min(
-            py_below(p.n_nodes - 1, p.seed, TAG_BCAST, r, n, j * p.powerlaw_gamma + g)
+            py_below(
+                p.n_nodes - 1, p.seed, TAG_BCAST, r, n,
+                slot * p.powerlaw_gamma + g, *suffix,
+            )
             for g in range(p.powerlaw_gamma)
         )
     else:
         assert p.topology == COMPLETE
-        t = py_below(p.n_nodes - 1, p.seed, TAG_BCAST, r, n, j)
+        t = py_below(p.n_nodes - 1, p.seed, TAG_BCAST, r, n, slot, *suffix)
     return t + 1 if t >= n else t
 
 
-def _sync_peer(p: SimParams, r: int, n: int) -> int:
-    q = py_below(p.n_nodes - 1, p.seed, TAG_SYNC, r, n)
+def _probe_target(p: SimParams, r: int, n: int, a: int) -> int:
+    suffix = () if a == 0 else (a,)
+    t = py_below(p.n_nodes - 1, p.seed, TAG_PROBE, r, n, *suffix)
+    return t + 1 if t >= n else t
+
+
+def _sync_peer(p: SimParams, r: int, n: int, a: int) -> int:
+    suffix = () if a == 0 else (a,)
+    q = py_below(p.n_nodes - 1, p.seed, TAG_SYNC, r, n, *suffix)
     return q + 1 if q >= n else q
 
 
 def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
-    N, K, T = p.n_nodes, p.n_changes, p.max_transmissions
+    N, K, T, D = p.n_nodes, p.n_changes, p.max_transmissions, p.churn_down_rounds
     max_rounds = p.max_rounds if max_rounds is None else max_rounds
+    S = max(1, p.nseq_max)
+    attempts = p.swim_probe_attempts if p.swim else 1
 
     origin = [py_below(N, p.seed, TAG_ORIGIN, k) for k in range(K)]
     inject_round = [py_below(p.write_rounds, p.seed, TAG_INJECT, k) for k in range(K)]
@@ -72,65 +99,166 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
         1 if py_below(1_000_000, p.seed, TAG_PART, n) < p.partition_frac_ppm else 0
         for n in range(N)
     ]
+    full = [int(m) for m in syncmod.full_masks(p)]
+    aidx, vidx, n_actors = syncmod.actor_index(p)
 
-    have: List[Set[int]] = [set() for _ in range(N)]
-    budget: List[Dict[int, int]] = [{} for _ in range(N)]
-    by_round: Dict[int, List[int]] = {}
+    def death(x: int, n: int) -> bool:
+        return (
+            0 <= x < p.churn_rounds
+            and p.churn_ppm > 0
+            and py_below(1_000_000, p.seed, TAG_CHURN, x, n) < p.churn_ppm
+        )
+
+    def alive_at(r: int, n: int) -> bool:
+        if p.churn_ppm == 0 or p.churn_rounds == 0 or D == 0:
+            return True
+        return not any(death(r - d, n) for d in range(1, D + 1))
+
+    cov: List[List[int]] = [[0] * K for _ in range(N)]
+    budget: List[List[int]] = [[0] * K for _ in range(N)]
+    status: List[List[int]] = [[ALIVE] * N, [ALIVE] * N]
+    since: List[List[int]] = [[0] * N, [0] * N]
+    by_round = {}
     for k in range(K):
         by_round.setdefault(inject_round[k], []).append(k)
 
+    def draw_excluding(n: int, draw, my_view: int):
+        """First candidate over `attempts` redraws not believed down."""
+        for a in range(attempts):
+            t = draw(a)
+            if status[my_view][t] != DOWN:
+                return t, True
+        return t, False
+
     result = RefResult(converged=False, rounds=max_rounds)
     for r in range(max_rounds):
-        part_on = r < p.partition_rounds
+        part_active = r < p.partition_rounds
+        pvec = part if part_active else [0] * N
+        alive = [alive_at(r, n) for n in range(N)]
+        restarted = [alive[n] and not alive_at(r - 1, n) for n in range(N)]
+
         # 1. inject
         for k in by_round.get(r, ()):  # noqa: B909 (read-only)
-            have[origin[k]].add(k)
-            budget[origin[k]][k] = T
-        # 2. broadcast: snapshot pending sets, deliver whole payloads
-        pend = [frozenset(k for k, b in budget[n].items() if b > 0) for n in range(N)]
-        delivered: List[Set[int]] = [set() for _ in range(N)]
+            cov[origin[k]][k] |= full[k]
+            budget[origin[k]][k] = max(budget[origin[k]][k], T)
+
+        # 2. SWIM: probes against round-start views, then per-view updates
+        if p.swim:
+            succ_v = [set(), set()]
+            fail_v = [set(), set()]
+            for n in range(N):
+                if not alive[n]:
+                    continue
+                t, found = draw_excluding(
+                    n, lambda a: _probe_target(p, r, n, a), part[n]
+                )
+                if not found:
+                    continue
+                ok = alive[t] and pvec[n] == pvec[t]
+                views = [part[n]] if part_active else [0, 1]
+                for v in views:
+                    (succ_v if ok else fail_v)[v].add(t)
+            for v in range(2):
+                for t in range(N):
+                    st, si = status[v][t], since[v][t]
+                    if st == SUSPECT and r - si >= p.swim_suspicion_rounds:
+                        st, si = DOWN, r
+                    if t in fail_v[v] and st == ALIVE:
+                        st = SUSPECT if p.swim_suspicion else DOWN
+                        si = r
+                    if t in succ_v[v] and st != ALIVE:
+                        st, si = ALIVE, r
+                    reach = (not part_active) or part[t] == v
+                    if reach and (
+                        (restarted[t] and st != ALIVE)
+                        or (
+                            alive[t]
+                            and st == DOWN
+                            and r - si >= p.swim_rejoin_rounds
+                        )
+                    ):
+                        st, si = ALIVE, r
+                    status[v][t], since[v][t] = st, si
+
+        # 3. broadcast: chunk-level fanout from round-start snapshots
+        pend = [
+            [budget[n][k] > 0 and alive[n] for k in range(K)] for n in range(N)
+        ]
+        snap = [list(row) for row in cov]
+        delivered: List[List[int]] = [[0] * K for _ in range(N)]
         for n in range(N):
-            if not pend[n]:
+            if not alive[n]:
                 continue
             for j in range(p.fanout):
-                t = _bcast_target(p, r, n, j)
-                if part_on and part[n] != part[t]:
-                    continue  # dropped at the partition boundary
-                delivered[t].update(pend[n])
-        # 3. receive: fresh budget for new changes, decrement for sent ones
+                for s in range(S):
+                    slot = j * S + s
+                    t, found = draw_excluding(
+                        n,
+                        lambda a, slot=slot: _bcast_target(p, r, n, slot, a),
+                        part[n],
+                    )
+                    if not found or pvec[n] != pvec[t] or not alive[t]:
+                        continue
+                    bit = 1 << s
+                    for k in range(K):
+                        if pend[n][k] and snap[n][k] & bit:
+                            delivered[t][k] |= bit
+
+        # 4. receive
         for n in range(N):
-            new = delivered[n] - have[n]
-            have[n] |= delivered[n]
-            for k in pend[n]:
-                if k not in new:
+            for k in range(K):
+                new = delivered[n][k] & ~cov[n][k] if alive[n] else 0
+                if new:
+                    cov[n][k] |= new
+                    budget[n][k] = T
+                elif pend[n][k]:
                     budget[n][k] -= 1
-            for k in new:
-                budget[n][k] = T
-        # 4. anti-entropy pull from one random peer (simultaneous snapshot)
+
+        # 5. anti-entropy: budgeted needs-based pull (simultaneous snapshot)
         if p.sync_interval > 0 and (r + 1) % p.sync_interval == 0:
-            snap = [frozenset(h) for h in have]
+            snap = [list(row) for row in cov]
             for n in range(N):
-                q = _sync_peer(p, r, n)
-                if part_on and part[n] != part[q]:
+                q, found = draw_excluding(
+                    n, lambda a: _sync_peer(p, r, n, a), part[n]
+                )
+                if not found or pvec[n] != pvec[q]:
                     continue
-                have[n] |= snap[q]
-        # 5. churn: restart keeps only the node's own persisted writes
-        if r < p.churn_rounds and p.churn_ppm > 0:
+                if not (alive[n] and alive[q]):
+                    continue
+                heads = syncmod.py_heads(snap[n], aidx, vidx, n_actors)
+                avail = syncmod.py_available(
+                    snap[n], snap[q], full, heads, aidx, vidx
+                )
+                pulled = syncmod.py_budget_transfer(avail, p.sync_chunk_budget)
+                for k in range(K):
+                    cov[n][k] |= pulled[k]
+
+        # 6. churn: deaths wipe to own writes; unresponsive for D rounds
+        if p.churn_ppm > 0 and p.churn_rounds > 0:
             for n in range(N):
-                if py_below(1_000_000, p.seed, TAG_CHURN, r, n) < p.churn_ppm:
-                    own = {
-                        k
-                        for k in range(K)
-                        if origin[k] == n and inject_round[k] <= r
-                    }
-                    have[n] = set(own)
-                    budget[n] = {k: T for k in own}
-        # 6. convergence = every node holds every changeset
-        total = sum(len(h) for h in have)
+                if death(r, n):
+                    for k in range(K):
+                        if origin[k] == n and inject_round[k] <= r:
+                            cov[n][k] = full[k]
+                            budget[n][k] = T
+                        else:
+                            cov[n][k] = 0
+                            budget[n][k] = 0
+
+        # 7. convergence = every node holds every chunk of every changeset
+        total = sum(
+            1 for n in range(N) for k in range(K) if cov[n][k] == full[k]
+        )
         result.coverage.append(total / float(N * K))
-        if total == N * K and all(len(h) == K for h in have):
+        if total == N * K:
             result.converged = True
             result.rounds = r + 1
             break
-    result.have = have
+
+    result.cov = cov
+    result.have = [
+        {k for k in range(K) if cov[n][k] == full[k]} for n in range(N)
+    ]
+    result.status = status
+    result.budget = budget
     return result

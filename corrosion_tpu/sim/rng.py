@@ -18,12 +18,17 @@ and desynchronize the two simulators.
 Stream tags (domain separation):
   TAG_ORIGIN  which node originates changeset k
   TAG_INJECT  which round changeset k is written
-  TAG_BCAST   broadcast fanout target for (round, node, slot)
-  TAG_SYNC    anti-entropy peer for (round, node)
-  TAG_PROBE   SWIM probe target for (round, node)
+  TAG_BCAST   broadcast fanout target for (round, node, slot[, attempt])
+  TAG_SYNC    anti-entropy peer for (round, node[, attempt])
+  TAG_PROBE   SWIM probe target for (round, node[, attempt])
   TAG_CHURN   per-(round, node) restart draw
   TAG_PART    partition-side assignment for node
   TAG_TOPO    static topology neighbor table entry (node, slot)
+  TAG_NSEQ    chunks-per-changeset draw for changeset k
+
+Draws that skip believed-down members append an ``attempt`` field for
+redraws — attempt 0 omits the field entirely, so runs where nothing is
+ever believed down are bit-identical to runs without SWIM modeling.
 """
 
 from __future__ import annotations
@@ -45,6 +50,8 @@ TAG_PROBE = 5
 TAG_CHURN = 6
 TAG_PART = 7
 TAG_TOPO = 8
+# 9 is TAG_KEY in sim/crdt.py (CRDT register keys)
+TAG_NSEQ = 10  # chunks-per-changeset draw
 
 
 def py_mix(x: int) -> int:

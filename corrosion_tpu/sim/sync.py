@@ -1,0 +1,290 @@
+"""Anti-entropy needs algebra as vectorized bitmap/mask operations.
+
+The runtime computes sync needs with per-actor version range sets
+(``corrosion_tpu/types/sync_state.py``, the port of
+crates/corro-types/src/sync.rs:125-247).  On TPU the same algebra is
+coverage **bitmasks**: changeset ``k`` has ``nseq[k] <= 8`` seq-chunks and
+a node's knowledge is one uint8 mask per (node, changeset) — seq-range
+reassembly as boolean coverage masks, per SURVEY.md §5.
+
+The serving rule mirrors ``SyncStateV1.compute_available_needs`` case by
+case (sync.rs:125-247); versions live per originating actor, ordered by
+changeset id:
+
+1. versions above the receiver's head (its highest version with any
+   coverage) are served from whatever the peer holds — complete versions
+   whole, partial versions from the peer's buffer (ref handle_known_version
+   serves partials mid-assembly, api/peer.rs:424-559);
+2. gap versions below the head that the receiver has nothing of are served
+   only when the peer holds them complete (the peer's "haves" exclude its
+   own partials, sync.rs:139-147);
+3. versions the receiver holds partially are served seq-wise: the peer's
+   coverage minus ours, whether the peer is partial or complete
+   (sync.rs:106-125 partial intersection).
+
+A per-session chunk budget models the reference's chunked streaming with
+server-side pacing (8 KiB chunks, adaptive shrink, peer.rs:611-667):
+chunks are taken in (version, seq) order until the budget is spent.
+
+Every function has a jax (``jx_``) and a scalar (``py_``) twin; the
+scalar twins drive sim/reference.py and the property tests cross-check
+both against the RangeSet algebra in types/sync_state.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .model import SimParams
+from .rng import TAG_NSEQ, TAG_ORIGIN, jx_below, py_below
+
+# -- chunk-shape constants (static per SimParams) ---------------------------
+
+
+def py_nseq(p: SimParams, k: int) -> int:
+    """Chunk count of changeset k, in [1, nseq_max]."""
+    if p.nseq_max <= 1:
+        return 1
+    return 1 + py_nseq_draw(p, k)
+
+
+def nseq_array(p: SimParams) -> np.ndarray:
+    """[K] int32 chunk counts (pure-python hash: K-sized constants must
+    stay concrete even when the caller is being traced under jit)."""
+    assert 1 <= p.nseq_max <= 8, "coverage masks are uint8"
+    if p.nseq_max <= 1:
+        return np.ones(p.n_changes, dtype=np.int32)
+    return np.array(
+        [1 + py_nseq_draw(p, k) for k in range(p.n_changes)], dtype=np.int32
+    )
+
+
+def py_nseq_draw(p: SimParams, k: int) -> int:
+    return py_below(p.nseq_max, p.seed, TAG_NSEQ, k)
+
+
+def full_masks(p: SimParams) -> np.ndarray:
+    """[K] uint8: the all-chunks coverage mask per changeset."""
+    return ((1 << nseq_array(p)) - 1).astype(np.uint8)
+
+
+def actor_index(p: SimParams) -> Tuple[np.ndarray, np.ndarray, int]:
+    """(aidx[K], vidx[K], n_actors): per-changeset originating-actor index
+    (dense reindex of distinct origins) and 1-based version number within
+    that actor (changeset id order = commit order, matching the runtime's
+    per-actor Version sequences, types/base.py)."""
+    origin = np.array(
+        [py_below(p.n_nodes, p.seed, TAG_ORIGIN, k) for k in range(p.n_changes)]
+    )
+    uniq, aidx = np.unique(origin, return_inverse=True)
+    vidx = np.zeros(p.n_changes, dtype=np.int32)
+    counts: Dict[int, int] = {}
+    for i in range(p.n_changes):
+        counts[origin[i]] = counts.get(origin[i], 0) + 1
+        vidx[i] = counts[origin[i]]
+    return aidx.astype(np.int32), vidx, len(uniq)
+
+
+# -- popcount / lowest-set-bits over uint8 masks ----------------------------
+
+_POPCOUNT8 = np.array([bin(i).count("1") for i in range(256)], dtype=np.int32)
+
+# _LOWBITS[b, m] = the lowest b set bits of mask m (b in 0..8)
+_LOWBITS = np.zeros((9, 256), dtype=np.uint8)
+for _m in range(256):
+    _bits = [i for i in range(8) if _m >> i & 1]
+    for _b in range(9):
+        _acc = 0
+        for _i in _bits[:_b]:
+            _acc |= 1 << _i
+        _LOWBITS[_b, _m] = _acc
+
+
+def jx_popcount8(m: jnp.ndarray) -> jnp.ndarray:
+    return jnp.take(jnp.asarray(_POPCOUNT8), m.astype(jnp.int32))
+
+
+def py_popcount8(m: int) -> int:
+    return int(_POPCOUNT8[m])
+
+
+def jx_lowest_bits(m: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lowest ``b`` set bits of each mask (b clipped to [0, 8])."""
+    b = jnp.clip(b, 0, 8)
+    flat = jnp.asarray(_LOWBITS).reshape(-1)
+    return jnp.take(flat, b * 256 + m.astype(jnp.int32)).astype(jnp.uint8)
+
+
+def py_lowest_bits(m: int, b: int) -> int:
+    return int(_LOWBITS[max(0, min(8, b)), m])
+
+
+# -- heads ------------------------------------------------------------------
+
+
+def jx_heads(cov: jnp.ndarray, aidx, vidx, n_actors: int) -> jnp.ndarray:
+    """[N, A] int32: per (node, actor) head = highest version with any
+    coverage (buffered partials count as seen, matching BookedVersions —
+    agent/bookkeeping.py), 0 when the node has nothing from that actor."""
+    seen_v = jnp.where(cov > 0, jnp.asarray(vidx)[None, :], 0)
+
+    def per_node(sv):
+        return jax.ops.segment_max(
+            sv, jnp.asarray(aidx), num_segments=n_actors
+        )
+
+    return jnp.maximum(jax.vmap(per_node)(seen_v), 0)
+
+
+def py_heads(
+    cov_row: Sequence[int], aidx: np.ndarray, vidx: np.ndarray, n_actors: int
+) -> List[int]:
+    heads = [0] * n_actors
+    for k, c in enumerate(cov_row):
+        if c:
+            heads[aidx[k]] = max(heads[aidx[k]], int(vidx[k]))
+    return heads
+
+
+# -- the needs rule ---------------------------------------------------------
+
+
+def jx_available(
+    cov_mine: jnp.ndarray,  # [N, K] uint8 (receiver rows)
+    cov_theirs: jnp.ndarray,  # [N, K] uint8 (peer rows, aligned)
+    full: jnp.ndarray,  # [K] uint8
+    heads_mine: jnp.ndarray,  # [N, A] int32 (receiver heads)
+    aidx,
+    vidx,
+) -> jnp.ndarray:
+    """[N, K] uint8: chunks the peer can serve us under the reference
+    needs algebra (cases 1-3 in the module docstring)."""
+    miss = cov_theirs & ~cov_mine
+    head_per_k = jnp.take_along_axis(
+        heads_mine, jnp.asarray(aidx)[None, :], axis=1
+    )
+    above_head = jnp.asarray(vidx)[None, :] > head_per_k
+    theirs_complete = cov_theirs == full[None, :]
+    gap = cov_mine == 0  # nothing of this version (and not above head)
+    servable = jnp.where(
+        above_head | ~gap, miss, jnp.where(theirs_complete, miss, 0)
+    )
+    return servable.astype(jnp.uint8)
+
+
+def py_available(
+    cov_mine: Sequence[int],
+    cov_theirs: Sequence[int],
+    full: Sequence[int],
+    heads_mine: Sequence[int],
+    aidx: np.ndarray,
+    vidx: np.ndarray,
+) -> List[int]:
+    out = []
+    for k in range(len(full)):
+        miss = cov_theirs[k] & ~cov_mine[k] & 0xFF
+        if vidx[k] > heads_mine[aidx[k]]:
+            out.append(miss)  # case 1: above our head
+        elif cov_mine[k] != 0:
+            out.append(miss)  # case 3: our partial, seq-wise
+        elif cov_theirs[k] == full[k]:
+            out.append(miss)  # case 2: gap, peer complete
+        else:
+            out.append(0)  # case 2: gap, peer partial → not served
+    return out
+
+
+# -- budgeted (version, seq)-ordered transfer -------------------------------
+
+
+def jx_budget_transfer(avail: jnp.ndarray, budget: int) -> jnp.ndarray:
+    """[N, K] uint8 → the first ``budget`` chunks of each row in (version,
+    seq) order; budget <= 0 means unlimited."""
+    if budget <= 0:
+        return avail
+    pc = jx_popcount8(avail)
+    cum = jnp.cumsum(pc, axis=1)
+    prev = cum - pc
+    return jnp.where(
+        cum <= budget,
+        avail,
+        jx_lowest_bits(avail, budget - prev),
+    ).astype(jnp.uint8)
+
+
+def py_budget_transfer(avail: Sequence[int], budget: int) -> List[int]:
+    if budget <= 0:
+        return list(avail)
+    out, spent = [], 0
+    for m in avail:
+        take = py_lowest_bits(m, budget - spent)
+        spent += py_popcount8(take)
+        out.append(take)
+    return out
+
+
+# -- bridge to the runtime's range algebra (for the property tests) ---------
+
+
+def state_from_cov(
+    cov_row: Sequence[int],
+    p: SimParams,
+    actor_ids,
+    self_actor,
+):
+    """Build a types.sync_state.SyncStateV1 from one node's coverage row.
+
+    ``actor_ids[a]`` maps the sim's dense actor index to an ActorId;
+    versions are the 1-based per-actor ``vidx``; a version's seq space is
+    ``[0, nseq[k] - 1]``.  Used by tests to check the bitmap rule against
+    ``compute_available_needs`` itself.
+    """
+    from ..types.sync_state import SyncStateV1
+
+    aidx, vidx, n_actors = actor_index(p)
+    nseq = nseq_array(p)
+    full = full_masks(p)
+    st = SyncStateV1(actor_id=self_actor)
+    by_actor: Dict[int, List[int]] = {}
+    for k in range(p.n_changes):
+        by_actor.setdefault(int(aidx[k]), []).append(k)
+    for a, ks in by_actor.items():
+        head = 0
+        for k in ks:
+            if cov_row[k]:
+                head = max(head, int(vidx[k]))
+        if head == 0:
+            continue
+        st.heads[actor_ids[a]] = head
+        need: List[Tuple[int, int]] = []
+        partial: Dict[int, List[Tuple[int, int]]] = {}
+        for k in ks:
+            v = int(vidx[k])
+            if v > head:
+                continue
+            c = cov_row[k]
+            if c == full[k]:
+                continue
+            if c == 0:
+                if need and need[-1][1] == v - 1:
+                    need[-1] = (need[-1][0], v)
+                else:
+                    need.append((v, v))
+            else:
+                gaps: List[Tuple[int, int]] = []
+                for s in range(int(nseq[k])):
+                    if not (c >> s) & 1:
+                        if gaps and gaps[-1][1] == s - 1:
+                            gaps[-1] = (gaps[-1][0], s)
+                        else:
+                            gaps.append((s, s))
+                partial[v] = gaps
+        if need:
+            st.need[actor_ids[a]] = need
+        if partial:
+            st.partial_need[actor_ids[a]] = partial
+    return st

@@ -1,0 +1,156 @@
+"""Property tests: the sim's bitmap needs algebra (sim/sync.py) against the
+runtime's RangeSet algebra (types/sync_state.py, the port of
+crates/corro-types/src/sync.rs:125-247).
+
+The bitmap rule must serve exactly the chunks the reference's
+``compute_available_needs`` would request and the server would stream: the
+two implementations are independent (uint8 masks vs version range sets),
+so equality here is earned, not by construction.
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim import model, sync as s
+from corrosion_tpu.types.actor import ActorId
+from corrosion_tpu.types.sync_state import (
+    SyncNeedFull,
+    SyncNeedPartial,
+)
+
+
+def make_params(seed=0, n_nodes=12, n_changes=10, nseq_max=4):
+    return model.SimParams(
+        n_nodes=n_nodes, n_changes=n_changes, nseq_max=nseq_max, seed=seed
+    )
+
+
+def random_cov(p, rng):
+    full = s.full_masks(p)
+    return [rng.randint(0, int(full[k])) for k in range(p.n_changes)]
+
+
+def actor_ids(n_actors):
+    # distinct from the node ids used as self-actors below
+    return {a: ActorId(bytes([0xAA, a]) + bytes(14)) for a in range(n_actors)}
+
+
+def needs_to_bits(p, needs, cov_mine, cov_theirs, ids):
+    """Expand compute_available_needs output into served chunk bits per k:
+    a Full need streams the peer's coverage of those versions; a Partial
+    need streams exactly its seq ranges."""
+    aidx, vidx, n_actors = s.actor_index(p)
+    by_actor_version = {}
+    for k in range(p.n_changes):
+        by_actor_version[(int(aidx[k]), int(vidx[k]))] = k
+    id_to_a = {ids[a]: a for a in ids}
+    bits = [0] * p.n_changes
+    for actor_id, lst in needs.items():
+        a = id_to_a[actor_id]
+        for need in lst:
+            if isinstance(need, SyncNeedFull):
+                for v in range(need.versions[0], need.versions[1] + 1):
+                    k = by_actor_version.get((a, v))
+                    if k is None:
+                        continue
+                    bits[k] |= cov_theirs[k] & ~cov_mine[k] & 0xFF
+            else:
+                assert isinstance(need, SyncNeedPartial)
+                k = by_actor_version[(a, need.version)]
+                m = 0
+                for lo, hi in need.seqs:
+                    for q in range(lo, hi + 1):
+                        m |= 1 << q
+                bits[k] |= m & cov_theirs[k]
+    return bits
+
+
+@pytest.mark.parametrize("trial", range(25))
+def test_bitmap_needs_match_rangeset_algebra(trial):
+    rng = random.Random(1000 + trial)
+    p = make_params(seed=trial)
+    aidx, vidx, n_actors = s.actor_index(p)
+    ids = actor_ids(n_actors)
+    full = [int(m) for m in s.full_masks(p)]
+
+    cov_mine = random_cov(p, rng)
+    cov_theirs = random_cov(p, rng)
+
+    st_mine = s.state_from_cov(cov_mine, p, ids, ActorId(bytes([1]) + bytes(15)))
+    st_theirs = s.state_from_cov(
+        cov_theirs, p, ids, ActorId(bytes([2]) + bytes(15))
+    )
+    needs = st_mine.compute_available_needs(st_theirs)
+    expect = needs_to_bits(p, needs, cov_mine, cov_theirs, ids)
+
+    heads = s.py_heads(cov_mine, aidx, vidx, n_actors)
+    got = s.py_available(cov_mine, cov_theirs, full, heads, aidx, vidx)
+    assert got == expect, (
+        f"bitmap rule diverged from RangeSet algebra:\n"
+        f"mine={cov_mine}\ntheirs={cov_theirs}\ngot={got}\nexpect={expect}"
+    )
+
+
+@pytest.mark.parametrize("trial", range(10))
+def test_jax_twins_match_scalar(trial):
+    rng = random.Random(2000 + trial)
+    p = make_params(seed=trial, n_nodes=9, n_changes=12)
+    aidx, vidx, n_actors = s.actor_index(p)
+    full = s.full_masks(p)
+    N = 6
+    cov = np.array([random_cov(p, rng) for _ in range(N)], dtype=np.uint8)
+    theirs = np.array([random_cov(p, rng) for _ in range(N)], dtype=np.uint8)
+
+    # heads
+    jx_h = np.asarray(s.jx_heads(jnp.asarray(cov), aidx, vidx, n_actors))
+    for n in range(N):
+        assert jx_h[n].tolist() == s.py_heads(cov[n], aidx, vidx, n_actors)
+
+    # available
+    jx_av = np.asarray(
+        s.jx_available(
+            jnp.asarray(cov), jnp.asarray(theirs), jnp.asarray(full),
+            jnp.asarray(jx_h), aidx, vidx,
+        )
+    )
+    for n in range(N):
+        py_av = s.py_available(
+            cov[n], theirs[n], [int(m) for m in full],
+            jx_h[n].tolist(), aidx, vidx,
+        )
+        assert jx_av[n].tolist() == py_av
+
+    # budgeted transfer at several budgets incl. 0 (= unlimited)
+    for budget in (0, 1, 3, 7, 100):
+        jx_t = np.asarray(
+            s.jx_budget_transfer(jnp.asarray(jx_av), budget)
+        )
+        for n in range(N):
+            assert jx_t[n].tolist() == s.py_budget_transfer(
+                jx_av[n].tolist(), budget
+            )
+
+
+def test_popcount_and_lowest_bits_tables():
+    for m in range(256):
+        assert s.py_popcount8(m) == bin(m).count("1")
+        for b in range(9):
+            low = s.py_lowest_bits(m, b)
+            assert low & m == low  # subset
+            assert s.py_popcount8(low) == min(b, s.py_popcount8(m))
+            # lowest: no set bit of m below any unset-in-low position
+            rest = m & ~low
+            if low and rest:
+                assert max(i for i in range(8) if low >> i & 1) < min(
+                    i for i in range(8) if rest >> i & 1
+                )
+    m = jnp.arange(256, dtype=jnp.uint8)
+    assert np.asarray(s.jx_popcount8(m)).tolist() == [
+        bin(i).count("1") for i in range(256)
+    ]
+    for b in (0, 2, 5, 8):
+        got = np.asarray(s.jx_lowest_bits(m, jnp.full((256,), b)))
+        assert got.tolist() == [s.py_lowest_bits(i, b) for i in range(256)]

@@ -72,9 +72,14 @@ def main() -> None:
     )
 
     # CRDT merge on the final state: every node must agree on every LWW
-    # register and causal length (one vmapped segment-max on device)
+    # register and causal length (one vmapped segment-max on device).
+    # Merge on COMPLETE changesets only — raw coverage masks would count a
+    # partially-covered changeset toward causal length / LWW candidacy,
+    # which the runtime never does (it applies only complete versions,
+    # agent/apply.py); matters whenever nseq_max > 1 (config 3).
     t0 = time.perf_counter()
-    reg, cl = crdt.merge_registers(res.state[0], p, n_keys=64)
+    have = cluster.complete_mask(res.state[0], p)
+    reg, cl = crdt.merge_registers(have, p, n_keys=64)
     reg_ok = bool((reg == reg[0]).all()) and bool((cl == cl[0]).all())
     crdt_s = time.perf_counter() - t0
     log(f"crdt merge agreement across nodes: {reg_ok} ({crdt_s:.2f}s)")

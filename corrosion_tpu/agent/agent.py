@@ -62,6 +62,16 @@ class Agent:
         conn = self.pool._write_conn
         assert conn is not None
         migrate(conn)
+        if self.config.actor_id is not None:
+            # explicit identity: swap the engine's site id (the mechanism
+            # `corrosion restore` uses to adopt a backup under a new
+            # identity, ref: corrosion/src/main.rs:241-292; also gives dev
+            # clusters reproducible actor ids)
+            conn.execute(
+                "UPDATE crsql_site_id SET site_id = ? WHERE ordinal = 0",
+                (bytes(self.config.actor_id),),
+            )
+            conn.commit()
         site = conn.execute("SELECT crsql_site_id()").fetchone()[0]
         self.actor_id = ActorId(bytes(site))
         self._restore_bookkeeping(conn)

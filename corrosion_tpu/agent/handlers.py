@@ -45,6 +45,7 @@ class ChangeIngest:
         self.queue: asyncio.Queue = asyncio.Queue()
         self._seen: "OrderedDict[tuple, None]" = OrderedDict()
         self._task: Optional[asyncio.Task] = None
+        self._processing = False
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._run())
@@ -71,26 +72,39 @@ class ChangeIngest:
             self._seen.popitem(last=False)
         return False
 
+    @property
+    def idle(self) -> bool:
+        """True when nothing is queued or mid-batch — the quiescence
+        signal harness.DevCluster.settle polls in round-paced mode."""
+        return self.queue.empty() and not self._processing
+
     async def _run(self) -> None:
         while True:
-            batch: List[Tuple[ChangeV1, str]] = [await self.queue.get()]
-            deadline = asyncio.get_running_loop().time() + self.flush_interval
-            while len(batch) < self.apply_queue_len:
-                timeout = deadline - asyncio.get_running_loop().time()
-                if timeout <= 0:
-                    break
-                try:
-                    batch.append(
-                        await asyncio.wait_for(self.queue.get(), timeout)
-                    )
-                except asyncio.TimeoutError:
-                    break
+            first = await self.queue.get()
+            self._processing = True  # set before any await point
             try:
-                await self._process_batch(batch)
-            except Exception:
-                logging.getLogger(__name__).exception(
-                    "change batch failed; will be retried via sync"
+                batch: List[Tuple[ChangeV1, str]] = [first]
+                deadline = (
+                    asyncio.get_running_loop().time() + self.flush_interval
                 )
+                while len(batch) < self.apply_queue_len:
+                    timeout = deadline - asyncio.get_running_loop().time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        batch.append(
+                            await asyncio.wait_for(self.queue.get(), timeout)
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                try:
+                    await self._process_batch(batch)
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "change batch failed; will be retried via sync"
+                    )
+            finally:
+                self._processing = False
 
     async def _process_batch(self, batch: List[Tuple[ChangeV1, str]]) -> None:
         to_apply: List[ChangeV1] = []

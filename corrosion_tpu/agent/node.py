@@ -43,11 +43,23 @@ ANNOUNCE_BACKOFF_MAX = 120.0
 class Node:
     """A full corrosion node (ref: run_root.rs task tree)."""
 
-    def __init__(self, config: Optional[Config] = None) -> None:
+    def __init__(
+        self,
+        config: Optional[Config] = None,
+        gossip_socks=None,
+        actor_id: Optional[ActorId] = None,
+    ) -> None:
+        """``gossip_socks``: optional pre-bound ``(udp_sock, tcp_sock)``
+        pair (transport.net.bind_port_pair) handed off by a harness that
+        pre-assigns ports — closes the probe-then-bind race.
+        ``actor_id``: optional explicit identity (site-id swap on open,
+        agent.open_sync) for reproducible dev clusters."""
         self.config = config or Config()
+        self._gossip_socks = gossip_socks
         self.agent = Agent(
             AgentConfig(
                 db_path=self.config.db.path,
+                actor_id=actor_id,
                 read_conns=self.config.db.read_conns,
             )
         )
@@ -109,6 +121,7 @@ class Node:
                 key_file=tls.client_key_file if tls.mtls else None,
                 insecure=tls.insecure,
             )
+        udp_sock, tcp_sock = self._gossip_socks or (None, None)
         self.transport = Transport(
             host=gossip_host,
             port=gossip_port,
@@ -117,6 +130,8 @@ class Node:
             on_bi_stream=self._on_bi_stream,
             ssl_server=ssl_server,
             ssl_client=ssl_client,
+            udp_sock=udp_sock,
+            tcp_sock=tcp_sock,
         )
         addr = await self.transport.start()
         self.transport.on_rtt = lambda a, rtt: self._on_rtt(a, rtt)
@@ -215,10 +230,12 @@ class Node:
             await site.start()
             self.prometheus_port = site._server.sockets[0].getsockname()[1]
 
-        self.broadcast.start()
+        if not self.config.perf.manual_pacing:
+            self.broadcast.start()
         self.ingest.start()
         self._tasks.append(asyncio.create_task(self._swim_loop()))
-        self._tasks.append(asyncio.create_task(self._sync_loop()))
+        if not self.config.perf.manual_pacing:
+            self._tasks.append(asyncio.create_task(self._sync_loop()))
         self._tasks.append(asyncio.create_task(self._persist_members_loop()))
         self._tasks.append(asyncio.create_task(self._announce_loop()))
         if self.config.telemetry.prometheus_addr:
@@ -277,7 +294,11 @@ class Node:
     # -- swim plumbing ----------------------------------------------------
 
     def _on_datagram(self, addr, data: bytes) -> None:
-        assert self.swim is not None
+        if self.swim is None:
+            # transport starts before the SWIM core exists (start order in
+            # start()); an eager peer's probe in that window is dropped —
+            # SWIM retries by design
+            return
         # both cores validate + decode internally; malformed peer datagrams
         # are dropped there and never escape into the protocol callback
         self.swim.handle_datagram(data, time.monotonic())
@@ -476,10 +497,16 @@ class Node:
             ups, key=lambda m: (m.ring if m.ring is not None else 9)
         )
         chosen = [(m.actor.id, m.addr) for m in ranked[:desired]]
+        return await self.sync_with(chosen)
+
+    async def sync_with(self, peers) -> int:
+        """Sync with explicitly chosen ``[(actor_id, addr)]`` peers; the
+        harness uses this in round-paced mode to match the round model's
+        one-random-peer pull (sim/model.py step 5)."""
         return await parallel_sync(
             self.agent,
             self.transport,
-            chosen,
+            peers,
             submit=self.ingest.submit,
             cluster_id=self.config.gossip.cluster_id,
         )

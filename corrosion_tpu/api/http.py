@@ -173,34 +173,44 @@ class Api:
 
         # stream in batches: the cursor lives on the read connection and is
         # advanced via to_thread, so large results never sit fully in memory
-        # (the reference's query path streams row-by-row, mod.rs:353+)
-        async with self.agent.pool.read() as conn:
-            try:
-                cur = await asyncio.to_thread(conn.execute, sql, params)
-                cols = [d[0] for d in cur.description] if cur.description else []
-            except Exception as e:
-                await resp.write(json.dumps({"error": str(e)}).encode() + b"\n")
-                await resp.write_eof()
-                return resp
-            await resp.write(json.dumps({"columns": cols}).encode() + b"\n")
-            rowid = 0
-            while True:
-                batch = await asyncio.to_thread(cur.fetchmany, 500)
-                if not batch:
-                    break
-                out = bytearray()
-                for row in batch:
-                    rowid += 1
-                    out += json.dumps(
-                        {"row": [rowid, [_encode_cell(c) for c in row]]}
-                    ).encode()
-                    out += b"\n"
-                await resp.write(bytes(out))
-        await resp.write(
-            json.dumps({"eoq": {"time": time.monotonic() - start}}).encode()
-            + b"\n"
-        )
-        await resp.write_eof()
+        # (the reference's query path streams row-by-row, mod.rs:353+);
+        # a client hanging up mid-stream just ends the response
+        try:
+            async with self.agent.pool.read() as conn:
+                try:
+                    cur = await asyncio.to_thread(conn.execute, sql, params)
+                    cols = (
+                        [d[0] for d in cur.description]
+                        if cur.description
+                        else []
+                    )
+                except Exception as e:
+                    await resp.write(
+                        json.dumps({"error": str(e)}).encode() + b"\n"
+                    )
+                    await resp.write_eof()
+                    return resp
+                await resp.write(json.dumps({"columns": cols}).encode() + b"\n")
+                rowid = 0
+                while True:
+                    batch = await asyncio.to_thread(cur.fetchmany, 500)
+                    if not batch:
+                        break
+                    out = bytearray()
+                    for row in batch:
+                        rowid += 1
+                        out += json.dumps(
+                            {"row": [rowid, [_encode_cell(c) for c in row]]}
+                        ).encode()
+                        out += b"\n"
+                    await resp.write(bytes(out))
+            await resp.write(
+                json.dumps({"eoq": {"time": time.monotonic() - start}}).encode()
+                + b"\n"
+            )
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError):
+            pass  # peer went away mid-stream; nothing left to tell them
         return resp
 
     async def migrations_handler(self, request: web.Request) -> web.Response:

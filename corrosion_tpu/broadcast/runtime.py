@@ -81,42 +81,86 @@ class BroadcastRuntime:
             payload = encode_uni_broadcast(cv, self.cluster_id, rebroadcast)
             await self._initial_fanout(payload)
 
-    async def _initial_fanout(self, payload: bytes) -> None:
+    def _initial_targets(self, payload: bytes):
+        """Choose initial-fanout targets and register the pending resend
+        (ref: broadcast/mod.rs:488-547).  Candidates are sorted by actor id
+        before the seeded shuffle so a seeded ``rng`` makes target choice
+        reproducible (membership-discovery order is not deterministic)."""
         ups = self.members.up_members()
         ring0 = self.members.ring0()
         ring0_ids = {m.actor.id for m in ring0}
-        others = [m for m in ups if m.actor.id not in ring0_ids]
+        others = sorted(
+            (m for m in ups if m.actor.id not in ring0_ids),
+            key=lambda m: bytes(m.actor.id),
+        )
         n_random = max(
             NUM_INDIRECT_PROBES,
             len(others) // (self.max_transmissions * 10) or 0,
         )
         self.rng.shuffle(others)
         targets = ring0 + others[:n_random]
+        if others[n_random:]:
+            self.pending.append(PendingBroadcast(payload=payload, send_count=1))
+        return targets
+
+    def _resend_tick(self, pending: List[PendingBroadcast]):
+        """One retransmission tick over ``pending``: sample
+        NUM_INDIRECT_PROBES random up members per payload, decrement
+        budgets (ref: broadcast/mod.rs:583-595)."""
+        ups = sorted(self.members.up_members(), key=lambda m: bytes(m.actor.id))
+        sends = []
+        if not ups:
+            return sends
+        for pb in pending:
+            sample = self.rng.sample(ups, min(NUM_INDIRECT_PROBES, len(ups)))
+            sends.extend((member.addr, pb.payload) for member in sample)
+            pb.send_count += 1
+            if pb.send_count >= self.max_transmissions:
+                self.pending.remove(pb)
+        return sends
+
+    async def _initial_fanout(self, payload: bytes) -> None:
         from ..utils.metrics import counter
 
-        for member in targets:
+        for member in self._initial_targets(payload):
             with contextlib.suppress(OSError, ConnectionError):
                 await self.transport.send_uni(member.addr, payload)
                 counter("corro.broadcast.sent").inc()
-        if others[n_random:]:
-            self.pending.append(PendingBroadcast(payload=payload, send_count=1))
 
     async def _resend_loop(self) -> None:
         while True:
             await asyncio.sleep(RESEND_TICK)
             if not self.pending:
                 continue
-            ups = self.members.up_members()
-            if not ups:
-                continue
             from ..utils.metrics import counter
 
-            for pb in list(self.pending):
-                sample = self.rng.sample(ups, min(NUM_INDIRECT_PROBES, len(ups)))
-                for member in sample:
-                    with contextlib.suppress(OSError, ConnectionError):
-                        await self.transport.send_uni(member.addr, pb.payload)
-                        counter("corro.broadcast.resent").inc()
-                pb.send_count += 1
-                if pb.send_count >= self.max_transmissions:
-                    self.pending.remove(pb)
+            for addr, payload in self._resend_tick(list(self.pending)):
+                with contextlib.suppress(OSError, ConnectionError):
+                    await self.transport.send_uni(addr, payload)
+                    counter("corro.broadcast.resent").inc()
+
+    # -- manual pacing (harness-driven rounds) ----------------------------
+
+    def collect_round(self):
+        """One harness-paced broadcast round, collection only: drain
+        freshly queued payloads through the initial-fanout policy and give
+        previously pending payloads one resend tick.  Returns the
+        ``(addr, payload)`` sends WITHOUT performing them, so a
+        round-synchronous driver (harness.DevCluster.step_round) can
+        collect every node's sends before any delivery lands — the pacing
+        abstraction the TPU round model (sim/model.py) is validated
+        against.  No awaits: target draws cannot interleave with
+        deliveries."""
+        prior = list(self.pending)
+        sends = []
+        while True:
+            try:
+                cv, rebroadcast = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            payload = encode_uni_broadcast(cv, self.cluster_id, rebroadcast)
+            sends.extend(
+                (m.addr, payload) for m in self._initial_targets(payload)
+            )
+        sends.extend(self._resend_tick(prior))
+        return sends

@@ -21,7 +21,9 @@ Two modes:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import os
+import random as _random
 import re
 import subprocess
 import sys
@@ -95,51 +97,85 @@ class DevCluster:
         topology: Topology | str,
         schema: Optional[str] = None,
         config_tweaks: Optional[dict] = None,
+        seeded_actors: bool = False,
     ) -> None:
+        """``seeded_actors``: derive each node's actor id from its
+        topology name (md5), so member orderings — and with them every
+        seeded random draw in round-paced mode — are reproducible across
+        cluster boots (the fidelity experiment needs run-to-run stable
+        trial outcomes)."""
         if isinstance(topology, str):
             topology = parse_topology(topology)
         self.topology = topology
         self.schema = schema
         self.config_tweaks = config_tweaks or {}
+        self.seeded_actors = seeded_actors
         self.nodes: Dict[str, "Node"] = {}  # noqa: F821
 
     async def start(self) -> "DevCluster":
         from ..agent.node import Node
+        from ..transport.net import bind_port_pair
         from ..types.config import Config
         from ..types.schema import apply_schema
 
         # pre-assign every node's gossip port so bootstrap lists are
         # complete regardless of start order (the reference assigns all
-        # ports before generating configs, main.rs:110-115); leaves still
-        # start first so responders are listening before initiators join
-        ports: Dict[str, int] = {
-            name: free_port() for name in self.topology.nodes
-        }
+        # ports before generating configs, main.rs:110-115); the sockets
+        # are bound HERE and handed off to each node's transport, so no
+        # probe-then-bind race can steal a port; leaves still start first
+        # so responders are listening before initiators join
+        socks = {name: bind_port_pair() for name in self.topology.nodes}
+        ports = {name: s[0] for name, s in socks.items()}
         order = self.topology.leaves() + self.topology.initiators()
-        for name in order:
-            cfg = Config()
-            cfg.db.path = ":memory:"
-            cfg.gossip.addr = f"127.0.0.1:{ports[name]}"
-            cfg.gossip.bootstrap = [
-                f"127.0.0.1:{ports[peer]}"
-                for peer in self.topology.edges[name]
-            ]
-            # fast timers for test clusters
-            cfg.gossip.probe_period = 0.3
-            cfg.gossip.probe_timeout = 0.15
-            cfg.gossip.suspicion_timeout = 1.0
-            cfg.perf.sync_interval_min = 0.3
-            cfg.perf.sync_interval_max = 1.0
-            for section, values in self.config_tweaks.items():
-                target = getattr(cfg, section)
-                for k, v in values.items():
-                    setattr(target, k, v)
-            node = await Node(cfg).start()
-            if self.schema:
-                await node.agent.pool.write_call(
-                    lambda c, s=self.schema: apply_schema(c, s)
-                )
-            self.nodes[name] = node
+        try:
+            for name in order:
+                cfg = Config()
+                cfg.db.path = ":memory:"
+                cfg.gossip.addr = f"127.0.0.1:{ports[name]}"
+                cfg.gossip.bootstrap = [
+                    f"127.0.0.1:{ports[peer]}"
+                    for peer in self.topology.edges[name]
+                ]
+                # fast timers for test clusters
+                cfg.gossip.probe_period = 0.3
+                cfg.gossip.probe_timeout = 0.15
+                cfg.gossip.suspicion_timeout = 1.0
+                cfg.perf.sync_interval_min = 0.3
+                cfg.perf.sync_interval_max = 1.0
+                for section, values in self.config_tweaks.items():
+                    target = getattr(cfg, section)
+                    for k, v in values.items():
+                        setattr(target, k, v)
+                actor_id = None
+                if self.seeded_actors:
+                    import hashlib
+
+                    from ..types.actor import ActorId
+
+                    actor_id = ActorId(
+                        hashlib.md5(name.encode()).digest()
+                    )
+                _, udp, tcp = socks.pop(name)
+                try:
+                    node = await Node(
+                        cfg, gossip_socks=(udp, tcp), actor_id=actor_id
+                    ).start()
+                except BaseException:
+                    # the transport may not have taken ownership yet —
+                    # close the handed-off pair so the fds don't leak
+                    for s in (udp, tcp):
+                        with contextlib.suppress(OSError):
+                            s.close()
+                    raise
+                if self.schema:
+                    await node.agent.pool.write_call(
+                        lambda c, s=self.schema: apply_schema(c, s)
+                    )
+                self.nodes[name] = node
+        finally:
+            for _, udp, tcp in socks.values():  # nodes that never started
+                udp.close()
+                tcp.close()
         return self
 
     async def stop(self) -> None:
@@ -183,6 +219,62 @@ class DevCluster:
                     f"distinct heads={len(set(heads))}"
                 )
             await asyncio.sleep(interval)
+
+    # -- round-paced driving (perf.manual_pacing) -------------------------
+
+    async def settle(
+        self,
+        quiet_checks: int = 3,
+        interval: float = 0.02,
+        timeout: float = 30.0,
+    ) -> None:
+        """Wait until every node's ingestion pipeline has been quiescent
+        for ``quiet_checks`` consecutive polls — the barrier between
+        phases of a manually paced round."""
+        deadline = time.monotonic() + timeout
+        quiet = 0
+        while quiet < quiet_checks:
+            if time.monotonic() > deadline:
+                raise TimeoutError("cluster did not settle")
+            await asyncio.sleep(interval)
+            if all(n.ingest.idle for n in self.nodes.values()):
+                quiet += 1
+            else:
+                quiet = 0
+
+    async def step_round(
+        self, r: int, sync_interval: int = 0, rng=None
+    ) -> None:
+        """Drive one round of the TPU simulator's round model
+        (sim/model.py) through the REAL protocol stack: every node's
+        broadcast fanout/resend tick is collected first (no deliveries
+        land mid-draw), then delivered over the real transport and applied
+        through real ingestion; every ``sync_interval`` rounds each node
+        then runs one real anti-entropy session with one uniformly chosen
+        up peer.  Requires nodes started with ``perf.manual_pacing``."""
+        collected = [
+            (node, node.broadcast.collect_round())
+            for node in self.nodes.values()
+        ]
+        for node, sends in collected:
+            for addr, payload in sends:
+                with contextlib.suppress(OSError, ConnectionError):
+                    await node.transport.send_uni(addr, payload)
+        await self.settle()
+        if sync_interval > 0 and (r + 1) % sync_interval == 0:
+            rng = rng or _random.Random()
+            jobs = []
+            for node in self.nodes.values():
+                ups = sorted(
+                    node.members.up_members(),
+                    key=lambda m: bytes(m.actor.id),
+                )
+                if not ups:
+                    continue
+                peer = rng.choice(ups)
+                jobs.append(node.sync_with([(peer.actor.id, peer.addr)]))
+            await asyncio.gather(*jobs, return_exceptions=True)
+            await self.settle()
 
 
 class SubprocessCluster:

@@ -128,34 +128,47 @@ def make_step(p: SimParams):
             a = jnp.logical_and(a, jnp.logical_not(death(r - d)))
         return a
 
-    def draw_excluding(down2, view, draw_fn):
+    def draw_excluding(down2, view_b, draw_fn):
         """First candidate (over ``attempts`` redraws) not believed down
         by its chooser — ``down2[v, t]`` is side-v's view of t, and node n
-        consults its OWN side's view ``down2[view[n], t]``; returns
-        (target[N], found[N])."""
+        consults its OWN side's view; ``view_b`` must broadcast against the
+        draw shape ([N] for per-node draws, [N, 1] for per-change [N, K]
+        draws).  Returns (target, found); target is the first candidate
+        when nothing was found (mirrored by reference.draw_excluding so
+        the exclusion chains below stay bit-identical)."""
         t = draw_fn(0)
-        ok = jnp.logical_not(down2[view, t])
+        ok = jnp.logical_not(down2[view_b, t])
         for a in range(1, attempts):
             cand = draw_fn(a)
             take = jnp.logical_and(
-                jnp.logical_not(ok), jnp.logical_not(down2[view, cand])
+                jnp.logical_not(ok), jnp.logical_not(down2[view_b, cand])
             )
             t = jnp.where(take, cand, t)
             ok = jnp.logical_or(ok, take)
         return t, ok
 
-    def bcast_target(r, slot: int, a: int):
-        """Fanout target per node for (round, slot, attempt) — mirrors
-        reference._bcast_target."""
+    nvec = narange[:, None]  # [N, 1]
+    kvec = karange[None, :]  # [1, K]
+
+    def bcast_target(r, slot: int, a: int, chosen):
+        """[N, K] fanout target per (node, changeset) for (round, slot,
+        attempt) — mirrors reference._bcast_target: targets are drawn PER
+        changeset-chunk payload (the runtime resends each pending payload
+        independently) and, on the complete topology, WITHOUT replacement
+        across the payload's fanout slots (the runtime samples distinct
+        members, broadcast/runtime.py): a shrunken-pool pick is mapped
+        through the ascending exclusions {self} ∪ chosen."""
         suffix = () if a == 0 else (a,)
         if p.topology == ER:
-            i = jx_below(p.er_degree, p.seed, TAG_BCAST, r, narange, slot, *suffix)
-            t = jx_below(N - 1, p.seed, TAG_TOPO, narange, i)
+            i = jx_below(
+                p.er_degree, p.seed, TAG_BCAST, r, nvec, slot, kvec, *suffix
+            )
+            t = jx_below(N - 1, p.seed, TAG_TOPO, nvec, i)
         elif p.topology == POWERLAW:
             draws = [
                 jx_below(
-                    N - 1, p.seed, TAG_BCAST, r, narange,
-                    slot * p.powerlaw_gamma + g, *suffix,
+                    N - 1, p.seed, TAG_BCAST, r, nvec,
+                    slot * p.powerlaw_gamma + g, kvec, *suffix,
                 )
                 for g in range(p.powerlaw_gamma)
             ]
@@ -164,8 +177,24 @@ def make_step(p: SimParams):
                 t = jnp.minimum(t, d)
         else:
             assert p.topology == COMPLETE
-            t = jx_below(N - 1, p.seed, TAG_BCAST, r, narange, slot, *suffix)
-        return t + (t >= narange)  # skip self
+            u = jx_below(
+                N - 1 - len(chosen), p.seed, TAG_BCAST, r, nvec, slot,
+                kvec, *suffix,
+            )
+            u = jnp.broadcast_to(u, (N, K)).astype(jnp.int32)
+            # elementwise-ascending exclusion maps (insertion network)
+            excl = [jnp.broadcast_to(nvec, (N, K))] + [
+                c.astype(jnp.int32) for c in chosen
+            ]
+            for i in range(1, len(excl)):
+                for j2 in range(i, 0, -1):
+                    lo = jnp.minimum(excl[j2 - 1], excl[j2])
+                    hi = jnp.maximum(excl[j2 - 1], excl[j2])
+                    excl[j2 - 1], excl[j2] = lo, hi
+            for e in excl:
+                u = u + (u >= e)
+            return u
+        return t + (t >= nvec)  # skip self
 
     def step(state: SimState) -> SimState:
         cov, budget, status, since, r = state
@@ -252,28 +281,34 @@ def make_step(p: SimParams):
         else:
             down2 = jnp.zeros((2, N), dtype=bool)
 
-        # 3. broadcast: each held chunk of each budgeted changeset is
-        # independently fanned out (chunked payloads take distinct paths);
-        # one boolean scatter plane per chunk bit (a max over mixed bit
-        # values would drop bits — OR semantics needed)
+        # 3. broadcast: each held chunk of each budgeted changeset is an
+        # independent payload fanned out to `fanout` (distinct, on the
+        # complete topology) targets — one boolean scatter plane per chunk
+        # bit (a max over mixed bit values would drop bits — OR semantics
+        # needed); targets are [N, K] so the scatter is elementwise
+        # (t[n, k], k) ← pay[n, k]
         pend = jnp.logical_and(budget > 0, alive[:, None])
         delivered = jnp.zeros_like(cov)
+        kk = jnp.broadcast_to(kvec, (N, K))
         for s in range(S):
             bit = jnp.uint8(1 << s)
             plane = jnp.zeros((N, K), dtype=bool)
+            hold = jnp.logical_and(pend, (cov & bit).astype(bool))
+            chosen = []
             for j in range(p.fanout):
                 slot = j * S + s
                 t, found = draw_excluding(
-                    down2, view, lambda a, slot=slot: bcast_target(r, slot, a)
+                    down2,
+                    view[:, None],
+                    lambda a, slot=slot, ch=tuple(chosen): bcast_target(
+                        r, slot, a, ch
+                    ),
                 )
                 ok = jnp.logical_and(
-                    jnp.logical_and(found, pvec == pvec[t]), alive[t]
+                    jnp.logical_and(found, pvec[:, None] == pvec[t]), alive[t]
                 )
-                pay = (
-                    jnp.logical_and(pend, (cov & bit).astype(bool))
-                    & ok[:, None]
-                )
-                plane = plane.at[t].max(pay)
+                plane = plane.at[t, kk].max(hold & ok)
+                chosen.append(t)
             delivered = delivered | jnp.where(plane, bit, jnp.uint8(0))
 
         # 4. receive: accumulate chunks, refresh budgets on new coverage

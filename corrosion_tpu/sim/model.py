@@ -24,10 +24,15 @@ calls for.  Per round, in order:
    Identity::renew, actor.rs:199-210).  Membership views are tracked per
    partition side (each side independently suspects the other).
 3. *Broadcast*: every live node with budgeted chunks sends each held
-   chunk to ``fanout`` targets it believes up — each chunk payload is
-   fanned out independently, the round model of one version's chunked
-   payloads taking different gossip paths (broadcast/mod.rs:377-599).
-   Deliveries to dead nodes or across an active partition are lost.
+   (changeset, chunk) payload to ``fanout`` targets it believes up —
+   each payload is fanned out independently with its own target draws
+   (the runtime resends every pending payload to an independent random
+   member sample, broadcast/mod.rs:583-595), and on the complete
+   topology the draws are WITHOUT replacement (the runtime samples
+   distinct members).  This per-payload/distinct policy is what the
+   round-count fidelity experiment against the real agent runtime
+   selected (tests/test_sim_vs_harness.py).  Deliveries to dead nodes
+   or across an active partition are lost.
 4. *Receive*: chunks landing on a live node accumulate in its coverage
    mask (partial buffering, util.rs:1392-1511); any new chunk refreshes
    that changeset's budget to ``max_transmissions`` (rebroadcast of

@@ -54,24 +54,39 @@ class RefResult:
     budget: List[List[int]] = field(default_factory=list)
 
 
-def _bcast_target(p: SimParams, r: int, n: int, slot: int, a: int) -> int:
-    """Fanout target for (round, node, slot, attempt) — mirrors
-    sim.cluster.bcast_target."""
+def _bcast_target(
+    p: SimParams, r: int, n: int, slot: int, k: int, a: int, chosen
+) -> int:
+    """Fanout target for (round, node, slot, changeset, attempt) — mirrors
+    sim.cluster's per-change draw.  Targets are drawn per changeset-chunk
+    payload (the runtime resends each pending payload independently,
+    broadcast/runtime.py) and, on the complete topology, WITHOUT
+    replacement across the fanout slots (the runtime samples distinct
+    members): ``chosen`` holds this payload's earlier slots' targets and
+    the draw maps a shrunken-pool pick through the ascending exclusions
+    {n} ∪ chosen."""
     suffix = () if a == 0 else (a,)
     if p.topology == ER:
-        i = py_below(p.er_degree, p.seed, TAG_BCAST, r, n, slot, *suffix)
+        i = py_below(p.er_degree, p.seed, TAG_BCAST, r, n, slot, k, *suffix)
         t = py_below(p.n_nodes - 1, p.seed, TAG_TOPO, n, i)
     elif p.topology == POWERLAW:
         t = min(
             py_below(
                 p.n_nodes - 1, p.seed, TAG_BCAST, r, n,
-                slot * p.powerlaw_gamma + g, *suffix,
+                slot * p.powerlaw_gamma + g, k, *suffix,
             )
             for g in range(p.powerlaw_gamma)
         )
     else:
         assert p.topology == COMPLETE
-        t = py_below(p.n_nodes - 1, p.seed, TAG_BCAST, r, n, slot, *suffix)
+        u = py_below(
+            p.n_nodes - 1 - len(chosen), p.seed, TAG_BCAST, r, n, slot, k,
+            *suffix,
+        )
+        for e in sorted([n] + list(chosen)):
+            if u >= e:
+                u += 1
+        return u
     return t + 1 if t >= n else t
 
 
@@ -123,12 +138,18 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
         by_round.setdefault(inject_round[k], []).append(k)
 
     def draw_excluding(n: int, draw, my_view: int):
-        """First candidate over `attempts` redraws not believed down."""
+        """First candidate over `attempts` redraws not believed down;
+        returns the FIRST candidate when nothing was found (the JAX twin
+        keeps its initial draw in that case — the value feeds the
+        distinct-fanout exclusion chain and must match bit-for-bit)."""
+        first = None
         for a in range(attempts):
             t = draw(a)
+            if first is None:
+                first = t
             if status[my_view][t] != DOWN:
                 return t, True
-        return t, False
+        return first, False
 
     result = RefResult(converged=False, rounds=max_rounds)
     for r in range(max_rounds):
@@ -180,7 +201,12 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
                         st, si = ALIVE, r
                     status[v][t], since[v][t] = st, si
 
-        # 3. broadcast: chunk-level fanout from round-start snapshots
+        # 3. broadcast: per-payload fanout from round-start snapshots —
+        # each (changeset, chunk) payload a node holds is independently
+        # fanned out to `fanout` targets, distinct per payload on the
+        # complete topology (matches the runtime's per-pending-broadcast
+        # distinct member sample, broadcast/runtime.py _resend_tick;
+        # fidelity pinned by tests/test_sim_vs_harness.py)
         pend = [
             [budget[n][k] > 0 and alive[n] for k in range(K)] for n in range(N)
         ]
@@ -189,20 +215,27 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
         for n in range(N):
             if not alive[n]:
                 continue
-            for j in range(p.fanout):
+            for k in range(K):
+                if not pend[n][k]:
+                    continue
                 for s in range(S):
-                    slot = j * S + s
-                    t, found = draw_excluding(
-                        n,
-                        lambda a, slot=slot: _bcast_target(p, r, n, slot, a),
-                        part[n],
-                    )
-                    if not found or pvec[n] != pvec[t] or not alive[t]:
-                        continue
                     bit = 1 << s
-                    for k in range(K):
-                        if pend[n][k] and snap[n][k] & bit:
-                            delivered[t][k] |= bit
+                    if not snap[n][k] & bit:
+                        continue
+                    chosen: List[int] = []
+                    for j in range(p.fanout):
+                        slot = j * S + s
+                        t, found = draw_excluding(
+                            n,
+                            lambda a, slot=slot, ch=chosen: _bcast_target(
+                                p, r, n, slot, k, a, ch
+                            ),
+                            part[n],
+                        )
+                        chosen.append(t)
+                        if not found or pvec[n] != pvec[t] or not alive[t]:
+                            continue
+                        delivered[t][k] |= bit
 
         # 4. receive
         for n in range(N):

@@ -27,6 +27,44 @@ from ..wire import WireError, deframe, frame
 
 Addr = Tuple[str, int]
 
+
+def bind_port_pair(host: str = "127.0.0.1"):
+    """Bind a UDP + TCP socket pair on one free port and hand them off.
+
+    The dev-cluster harness must know every node's port before any node
+    starts (bootstrap lists reference peers, harness/__init__.py), but a
+    probe-then-release ``free_port()`` races other processes between the
+    release and the node's bind (observed EADDRINUSE flakes).  Binding
+    both sockets here and passing them into :class:`Transport` closes the
+    window entirely.  Returns ``(port, udp_sock, tcp_sock)``.
+    """
+    import socket as socketmod
+
+    last_err: Optional[OSError] = None
+    for _ in range(64):
+        udp = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_DGRAM)
+        try:
+            udp.bind((host, 0))
+        except OSError as e:
+            udp.close()
+            last_err = e
+            continue
+        port = udp.getsockname()[1]
+        tcp = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
+        tcp.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_REUSEADDR, 1)
+        try:
+            tcp.bind((host, port))
+            tcp.listen(128)
+        except OSError as e:
+            udp.close()
+            tcp.close()
+            last_err = e
+            continue  # TCP side of this port taken; redraw
+        udp.setblocking(False)
+        tcp.setblocking(False)
+        return port, udp, tcp
+    raise OSError(f"could not bind a UDP+TCP port pair: {last_err}")
+
 UNI_MAGIC = b"U"
 BI_MAGIC = b"B"
 
@@ -105,9 +143,13 @@ class Transport:
         ] = None,
         ssl_server=None,  # ssl.SSLContext for the TCP listener
         ssl_client=None,  # ssl.SSLContext for outgoing stream connections
+        udp_sock=None,  # pre-bound sockets (bind_port_pair) — hand-off
+        tcp_sock=None,  # avoids the probe-then-bind port race in harnesses
     ) -> None:
         self.host = host
         self.port = port
+        self._udp_sock = udp_sock
+        self._tcp_sock = tcp_sock
         self.ssl_server = ssl_server
         self.ssl_client = ssl_client
         self.on_datagram = on_datagram or (lambda a, d: None)
@@ -128,14 +170,24 @@ class Transport:
 
     async def start(self) -> Addr:
         loop = asyncio.get_running_loop()
-        self._udp, _proto = await loop.create_datagram_endpoint(
-            lambda: _Datagram(self._handle_datagram),
-            local_addr=(self.host, self.port),
-        )
+        if self._udp_sock is not None:
+            self._udp, _proto = await loop.create_datagram_endpoint(
+                lambda: _Datagram(self._handle_datagram), sock=self._udp_sock
+            )
+        else:
+            self._udp, _proto = await loop.create_datagram_endpoint(
+                lambda: _Datagram(self._handle_datagram),
+                local_addr=(self.host, self.port),
+            )
         udp_port = self._udp.get_extra_info("sockname")[1]
-        self._tcp = await asyncio.start_server(
-            self._handle_conn, self.host, udp_port, ssl=self.ssl_server
-        )
+        if self._tcp_sock is not None:
+            self._tcp = await asyncio.start_server(
+                self._handle_conn, sock=self._tcp_sock, ssl=self.ssl_server
+            )
+        else:
+            self._tcp = await asyncio.start_server(
+                self._handle_conn, self.host, udp_port, ssl=self.ssl_server
+            )
         self.port = udp_port
         return (self.host, self.port)
 

@@ -89,6 +89,12 @@ class PerfConfig:
     flush_interval: float = 0.05
     sync_interval_min: float = 1.0
     sync_interval_max: float = 15.0  # ref: MAX_SYNC_BACKOFF (agent/mod.rs:33)
+    # Harness-driven round pacing: when True the node does NOT free-run its
+    # broadcast resend/fanout tasks or the anti-entropy loop — the dev
+    # cluster harness drives them round-synchronously (DevCluster.step_round)
+    # so rounds-to-convergence is countable against the TPU round model
+    # (the virtual-time hook SURVEY.md §7 step 8 calls for).
+    manual_pacing: bool = False
 
 
 @dataclass

@@ -2,82 +2,95 @@
 one node commits many rows across several transactions — including one
 large version that must chunk and buffer — then fresh nodes chain-bootstrap
 and reach the full row count via anti-entropy sync alone (no broadcasts:
-the writes happen before the joiners exist).  Scaled from the reference's
-65k rows to stay fast in CI; the structure (multi-chunk version + chained
-bootstrap) is preserved.
+the writes happen before the joiners exist).
+
+The default tier keeps the reference's hard part at FULL scale — the
+single 10 000-row version that must chunk into many 8 KiB changesets and
+reassemble gap-free (tests.rs:605-613) — with 20k total rows; the slow
+tier is the complete 65 000-row / 101-transaction port (tests.rs:605-731).
 """
 
 import asyncio
 
+import pytest
 from aiohttp import ClientSession
 
 from tests.test_cluster import SCHEMA, boot_node, wait_for
 
-TOTAL_ROWS = 1200
-BIG_TX_ROWS = 800  # one version large enough for many 8 KiB chunks
+BIG_TX_ROWS = 10_000  # ref: the one 10k-row changeset (tests.rs:608)
+
+
+async def _large_tx_sync(total_rows: int, small_tx_rows: int, timeout: float):
+    n1 = await boot_node()
+    try:
+        async with ClientSession() as http:
+            # one big multi-chunk version
+            stmts = [
+                ["INSERT INTO tests (id,text) VALUES (?,?)", [i, f"big{i:06d}" * 4]]
+                for i in range(BIG_TX_ROWS)
+            ]
+            r = await http.post(f"{n1.api_base}/v1/transactions", json=stmts)
+            assert r.status == 200, await r.text()
+            # then many smaller versions (ref: 100 txns of 550 rows)
+            for i in range(BIG_TX_ROWS, total_rows, small_tx_rows):
+                stmts = [
+                    ["INSERT INTO tests (id,text) VALUES (?,?)", [j, f"v{j}"]]
+                    for j in range(i, min(i + small_tx_rows, total_rows))
+                ]
+                r = await http.post(f"{n1.api_base}/v1/transactions", json=stmts)
+                assert r.status == 200
+
+        # the big version really was chunked
+        big = n1.agent.bookie.get(n1.agent.actor_id).versions.current[1]
+        assert big.last_seq == BIG_TX_ROWS - 1
+
+        # chain bootstrap: n2 -> n1, n3 -> n2, n4 -> n3
+        n2 = await boot_node(bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"])
+        n3 = await boot_node(bootstrap=[f"127.0.0.1:{n2.gossip_addr[1]}"])
+        n4 = await boot_node(bootstrap=[f"127.0.0.1:{n3.gossip_addr[1]}"])
+        joiners = [n2, n3, n4]
+        try:
+
+            async def all_synced():
+                for n in joiners:
+                    rows = await n.agent.pool.read_call(
+                        lambda c: c.execute(
+                            "SELECT COUNT(*) FROM tests"
+                        ).fetchone()
+                    )
+                    if rows != (total_rows,):
+                        return False
+                return all(
+                    n.agent.generate_sync().need_len() == 0 for n in joiners
+                )
+
+            await wait_for(
+                all_synced, timeout=timeout, msg="chained large sync"
+            )
+
+            # no leftover buffering anywhere (ref: tests.rs:713-719
+            # buffered-change asserts on failure)
+            for n in joiners:
+                leftovers = await n.agent.pool.read_call(
+                    lambda c: c.execute(
+                        "SELECT (SELECT COUNT(*) FROM __corro_buffered_changes), "
+                        "(SELECT COUNT(*) FROM __corro_seq_bookkeeping)"
+                    ).fetchone()
+                )
+                assert leftovers == (0, 0)
+        finally:
+            for n in reversed(joiners):
+                await n.stop()
+    finally:
+        await n1.stop()
 
 
 def test_large_tx_sync():
-    async def main():
-        n1 = await boot_node()
-        try:
-            async with ClientSession() as http:
-                # one big multi-chunk version
-                stmts = [
-                    ["INSERT INTO tests (id,text) VALUES (?,?)", [i, f"big{i:06d}" * 4]]
-                    for i in range(BIG_TX_ROWS)
-                ]
-                r = await http.post(f"{n1.api_base}/v1/transactions", json=stmts)
-                assert r.status == 200, await r.text()
-                # then many small versions
-                for i in range(BIG_TX_ROWS, TOTAL_ROWS, 100):
-                    stmts = [
-                        ["INSERT INTO tests (id,text) VALUES (?,?)", [j, f"v{j}"]]
-                        for j in range(i, min(i + 100, TOTAL_ROWS))
-                    ]
-                    r = await http.post(f"{n1.api_base}/v1/transactions", json=stmts)
-                    assert r.status == 200
+    """10k-row chunked version + 10k small-version rows."""
+    asyncio.run(_large_tx_sync(20_000, 500, timeout=120.0))
 
-            # the big version really was chunked
-            big = n1.agent.bookie.get(n1.agent.actor_id).versions.current[1]
-            assert big.last_seq == BIG_TX_ROWS - 1
 
-            # chain bootstrap: n2 -> n1, n3 -> n2, n4 -> n3
-            n2 = await boot_node(bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"])
-            n3 = await boot_node(bootstrap=[f"127.0.0.1:{n2.gossip_addr[1]}"])
-            n4 = await boot_node(bootstrap=[f"127.0.0.1:{n3.gossip_addr[1]}"])
-            joiners = [n2, n3, n4]
-            try:
-
-                async def all_synced():
-                    for n in joiners:
-                        rows = await n.agent.pool.read_call(
-                            lambda c: c.execute(
-                                "SELECT COUNT(*) FROM tests"
-                            ).fetchone()
-                        )
-                        if rows != (TOTAL_ROWS,):
-                            return False
-                    return all(
-                        n.agent.generate_sync().need_len() == 0 for n in joiners
-                    )
-
-                await wait_for(all_synced, timeout=60.0, msg="chained large sync")
-
-                # no leftover buffering anywhere (ref: tests.rs:713-719
-                # buffered-change asserts on failure)
-                for n in joiners:
-                    leftovers = await n.agent.pool.read_call(
-                        lambda c: c.execute(
-                            "SELECT (SELECT COUNT(*) FROM __corro_buffered_changes), "
-                            "(SELECT COUNT(*) FROM __corro_seq_bookkeeping)"
-                        ).fetchone()
-                    )
-                    assert leftovers == (0, 0)
-            finally:
-                for n in reversed(joiners):
-                    await n.stop()
-        finally:
-            await n1.stop()
-
-    asyncio.run(main())
+@pytest.mark.slow
+def test_large_tx_sync_full_65k():
+    """The complete 65k-row port: 10k big version + 100 txns of 550."""
+    asyncio.run(_large_tx_sync(65_000, 550, timeout=300.0))

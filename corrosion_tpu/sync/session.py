@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import random
 import time
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
@@ -37,11 +38,18 @@ from ..utils.tracing import current_traceparent, span
 from .. import wire
 
 MAX_CONCURRENT_SYNCS = 3  # ref: agent.rs:131 sync permit semaphore
+MAX_CONCURRENT_VERSION_JOBS = 6  # ref: peer.rs:680-686 buffer_unordered(6)
 ADAPTIVE_MIN_CHUNK = 1024  # ref: peer.rs adaptive floor 1 KiB
 SLOW_SEND_THRESHOLD = 0.5  # ref: peer.rs:641-654 (500 ms halves the budget)
 ABORT_SEND_THRESHOLD = 5.0  # ref: peer.rs abort >5 s
 HANDSHAKE_TIMEOUT = 2.0  # ref: peer.rs:982,992 (2 s state/clock timeouts)
-REQUEST_CHUNK = 10  # ref: peer.rs:1081 needs chunked in ranges of 10
+FULL_RANGE_CHUNK = 10  # ref: peer.rs:1081 full needs chunked in ranges of 10
+REQUEST_CHUNK = 10  # ref: peer.rs:1124-1239 ≤10 reqs per peer per turn
+# Cap on materialized request items per peer per round: peer-advertised
+# heads are untrusted wire values, and chunking a (1, 10**15) span must
+# not allocate 10**14 need objects; anything beyond the cap is picked up
+# by later anti-entropy rounds (sync is iterative by design).
+MAX_SESSION_REQ_ITEMS = 1000
 
 
 class SyncServer:
@@ -99,22 +107,60 @@ class SyncServer:
             await fs.send(
                 wire.encode_sync_clock(self.agent.clock.new_timestamp())
             )
-            # requests until fin
-            while True:
-                data = await fs.recv(timeout=30.0)
-                if data is None:
-                    return
-                kind, payload = wire.decode_sync(data)
-                if kind == "request_fin":
-                    break
-                if kind != "request":
-                    continue
-                for actor_id, needs in payload:
-                    for need in needs:
-                        await self._serve_need(fs, actor_id, need)
+            # requests until fin; each need becomes a version job — at most
+            # MAX_CONCURRENT_VERSION_JOBS run at once while further request
+            # frames keep being read (ref: process_sync's buffer_unordered
+            # job pool, peer.rs:669-827); sends interleave under a lock
+            # (chunks are self-describing (version, seqs) — receivers
+            # reassemble order-independently)
+            send_lock = asyncio.Lock()
+            sem = asyncio.Semaphore(MAX_CONCURRENT_VERSION_JOBS)
+            in_flight: set = set()
+
+            async def job(actor_id, need):
+                try:
+                    await self._serve_need(fs, actor_id, need, send_lock)
+                except Exception as e:
+                    counter(
+                        "corro.sync.server.job.errors", kind=type(e).__name__
+                    ).inc()
+                finally:
+                    sem.release()
+
+            try:
+                while True:
+                    data = await fs.recv(timeout=30.0)
+                    if data is None:
+                        return
+                    kind, payload = wire.decode_sync(data)
+                    if kind == "request_fin":
+                        break
+                    if kind != "request":
+                        continue
+                    for actor_id, needs in payload:
+                        for need in needs:
+                            # acquire BEFORE spawning: ≤6 tasks ever exist,
+                            # and a flooding client is backpressured at the
+                            # frame-read loop (the reference gets this from
+                            # buffer_unordered's stream pull semantics)
+                            await sem.acquire()
+                            t = asyncio.create_task(job(actor_id, need))
+                            in_flight.add(t)
+                            t.add_done_callback(in_flight.discard)
+                if in_flight:
+                    await asyncio.wait(set(in_flight))
+            finally:
+                for t in in_flight:
+                    t.cancel()
             await fs.send(wire.pack(("done",)))
 
-    async def _serve_need(self, fs: FramedStream, actor_id: ActorId, need) -> None:
+    async def _serve_need(
+        self,
+        fs: FramedStream,
+        actor_id: ActorId,
+        need,
+        send_lock: asyncio.Lock,
+    ) -> None:
         """ref: process_sync → process_version → handle_known_version,
         peer.rs:350-827"""
         if isinstance(need, SyncNeedFull):
@@ -140,18 +186,21 @@ class SyncServer:
                     for cs, ce in booked.versions.cleared.overlapping(s, e)
                 ]
             for crange in cleared:
-                await fs.send(
-                    wire.encode_sync_changeset(
-                        ChangeV1(
-                            actor_id=actor_id,
-                            changeset=ChangesetEmpty(versions=crange),
+                async with send_lock:
+                    await fs.send(
+                        wire.encode_sync_changeset(
+                            ChangeV1(
+                                actor_id=actor_id,
+                                changeset=ChangesetEmpty(versions=crange),
+                            )
                         )
                     )
-                )
             for version in known:
-                await self._serve_version(fs, actor_id, version, None)
+                await self._serve_version(fs, actor_id, version, None, send_lock)
         elif isinstance(need, SyncNeedPartial):
-            await self._serve_version(fs, actor_id, need.version, list(need.seqs))
+            await self._serve_version(
+                fs, actor_id, need.version, list(need.seqs), send_lock
+            )
 
 
     async def _serve_version(
@@ -160,16 +209,49 @@ class SyncServer:
         actor_id: ActorId,
         version: int,
         seqs_filter: Optional[List[Tuple[int, int]]],
+        send_lock: asyncio.Lock,
     ) -> None:
+        """ref: process_version → handle_known_version, peer.rs:350-667.
+
+        The partial→current flip hazard (peer.rs:455-506): between the
+        needs computation and this serve — or mid-serve — a buffered
+        partial can finish gap-free reassembly and flip to Current,
+        deleting its ``__corro_buffered_changes`` rows.  Bookkeeping is
+        therefore re-validated and the buffer rows snapshotted UNDER THE
+        BOOKED WRITE LOCK (ingestion's apply/flush also takes it,
+        agent/apply.py), so this job either reads a consistent partial
+        buffer or observes the flip and serves the — now immutable —
+        current version instead; the ``seqs_filter`` carries over, so the
+        client still receives the seq ranges it asked for."""
         booked = self.agent.bookie.get(actor_id)
         if booked is None:
             return
-        async with booked.read(f"serve_sync:{actor_id.as_simple()}"):
+        partial_rows: Optional[list] = None
+        async with booked.write(
+            f"serve_sync(flip check):{actor_id.as_simple()}"
+        ):
             known = booked.versions.get(version)
+            if isinstance(known, Partial):
+                known = Partial(
+                    seqs=RangeSet(list(known.seqs)),
+                    last_seq=known.last_seq,
+                    ts=known.ts,
+                )
+                partial_rows = await self.agent.pool.read_call(
+                    lambda conn: conn.execute(
+                        'SELECT "table", pk, cid, val, col_version, '
+                        "db_version, seq, site_id, cl FROM "
+                        "__corro_buffered_changes WHERE site_id = ? AND "
+                        "version = ? ORDER BY seq ASC",
+                        (actor_id, version),
+                    ).fetchall()
+                )
         if known is None:
             return
 
         if isinstance(known, Current):
+            # crsql_changes rows for a committed db_version are immutable —
+            # safe to read outside the lock
             rows = await self.agent.pool.read_call(
                 lambda conn: conn.execute(
                     f"SELECT {_CHANGE_COLS} FROM crsql_changes WHERE site_id = ? "
@@ -179,30 +261,14 @@ class SyncServer:
             )
             changes = [_row_to_change(r) for r in rows]
             await self._stream_chunks(
-                fs, actor_id, version, changes, known.last_seq, known.ts, seqs_filter
+                fs, actor_id, version, changes, known.last_seq, known.ts,
+                seqs_filter, send_lock,
             )
         elif isinstance(known, Partial):
             # serve what we have from the buffered-changes table
-            # (ref: peer.rs:424-559 partial serving mid-assembly).
-            # snapshot the seq set under the read lock: concurrent ingestion
-            # mutates the live Partial's RangeSet
-            async with booked.read(f"serve_sync:{actor_id.as_simple()}"):
-                seq_ranges = list(known.seqs)
-                last_seq = known.last_seq
-                ts = known.ts
-            known = Partial(
-                seqs=RangeSet(seq_ranges), last_seq=last_seq, ts=ts
-            )
-            rows = await self.agent.pool.read_call(
-                lambda conn: conn.execute(
-                    'SELECT "table", pk, cid, val, col_version, db_version, '
-                    "seq, site_id, cl FROM __corro_buffered_changes WHERE "
-                    "site_id = ? AND version = ? ORDER BY seq ASC",
-                    (actor_id, version),
-                ).fetchall()
-            )
-            changes = [_row_to_change(r) for r in rows]
-            for s, e in seq_ranges:
+            # (ref: peer.rs:424-559 partial serving mid-assembly)
+            changes = [_row_to_change(r) for r in partial_rows]
+            for s, e in known.seqs:
                 part = [c for c in changes if s <= c.seq <= e]
                 await self._stream_chunks(
                     fs,
@@ -212,17 +278,19 @@ class SyncServer:
                     known.last_seq,
                     known.ts,
                     seqs_filter,
+                    send_lock,
                     cover=(s, e),
                 )
         else:  # Cleared
-            await fs.send(
-                wire.encode_sync_changeset(
-                    ChangeV1(
-                        actor_id=actor_id,
-                        changeset=ChangesetEmpty(versions=(version, version)),
+            async with send_lock:
+                await fs.send(
+                    wire.encode_sync_changeset(
+                        ChangeV1(
+                            actor_id=actor_id,
+                            changeset=ChangesetEmpty(versions=(version, version)),
+                        )
                     )
                 )
-            )
 
     async def _stream_chunks(
         self,
@@ -233,6 +301,7 @@ class SyncServer:
         last_seq: int,
         ts: int,
         seqs_filter: Optional[List[Tuple[int, int]]],
+        send_lock: asyncio.Lock,
         cover: Optional[Tuple[int, int]] = None,
     ) -> None:
         """Adaptive chunked streaming (ref: send_change_chunks,
@@ -249,20 +318,21 @@ class SyncServer:
         )
         for chunk, seq_range in chunker:
             t0 = time.monotonic()
-            await fs.send(
-                wire.encode_sync_changeset(
-                    ChangeV1(
-                        actor_id=actor_id,
-                        changeset=ChangesetFull(
-                            version=version,
-                            changes=tuple(chunk),
-                            seqs=seq_range,
-                            last_seq=last_seq,
-                            ts=ts,
-                        ),
+            async with send_lock:
+                await fs.send(
+                    wire.encode_sync_changeset(
+                        ChangeV1(
+                            actor_id=actor_id,
+                            changeset=ChangesetFull(
+                                version=version,
+                                changes=tuple(chunk),
+                                seqs=seq_range,
+                                last_seq=last_seq,
+                                ts=ts,
+                            ),
+                        )
                     )
                 )
-            )
             elapsed = time.monotonic() - t0
             counter("corro.sync.server.chunks.sent").inc()
             histogram("corro.sync.server.chunk.send.seconds").observe(elapsed)
@@ -361,23 +431,35 @@ async def _parallel_sync_traced(
             continue
         sessions.append((actor_id, fs, their_state))
 
-    # 2. allocate needs across peers, dedup via claimed range sets
+    # 2. allocate needs across peers, dedup via claimed range sets;
+    # full-version spans are first chunked into ranges of ≤10 versions
+    # (ref: peer.rs:1081 chunks(10)) so big catch-ups spread across peers
     claimed_full: Dict[ActorId, RangeSet] = {}
     claimed_partial: Dict[Tuple[ActorId, int], RangeSet] = {}
-    assignments: List[Tuple[FramedStream, Dict[ActorId, list]]] = []
+    assignments: List[Tuple[FramedStream, List[Tuple[ActorId, object]]]] = []
     for actor_id, fs, their_state in sessions:
         serveable = our_state.compute_available_needs(their_state)
-        mine: Dict[ActorId, list] = {}
+        mine: List[Tuple[ActorId, object]] = []
         for origin, needs in serveable.items():
+            if len(mine) >= MAX_SESSION_REQ_ITEMS:
+                break
             cf = claimed_full.setdefault(origin, RangeSet())
             for need in needs:
+                if len(mine) >= MAX_SESSION_REQ_ITEMS:
+                    break
                 if isinstance(need, SyncNeedFull):
                     s, e = need.versions
                     for gs, ge in list(cf.gaps(s, e)):
-                        mine.setdefault(origin, []).append(
-                            SyncNeedFull(versions=(gs, ge))
-                        )
-                        cf.insert(gs, ge)
+                        cs = gs
+                        # only claim what we actually request, so another
+                        # peer (or a later round) picks up the remainder
+                        while cs <= ge and len(mine) < MAX_SESSION_REQ_ITEMS:
+                            ce = min(cs + FULL_RANGE_CHUNK - 1, ge)
+                            mine.append(
+                                (origin, SyncNeedFull(versions=(cs, ce)))
+                            )
+                            cf.insert(cs, ce)
+                            cs = ce + 1
                 else:
                     cp = claimed_partial.setdefault(
                         (origin, need.version), RangeSet()
@@ -388,22 +470,36 @@ async def _parallel_sync_traced(
                     if unclaimed:
                         for s, e in unclaimed:
                             cp.insert(s, e)
-                        mine.setdefault(origin, []).append(
-                            SyncNeedPartial(
-                                version=need.version, seqs=tuple(unclaimed)
+                        mine.append(
+                            (
+                                origin,
+                                SyncNeedPartial(
+                                    version=need.version,
+                                    seqs=tuple(unclaimed),
+                                ),
                             )
                         )
+        # shuffle so a peer doesn't receive one actor's whole history in
+        # version order while other actors wait (ref: peer.rs:1122 shuffle)
+        random.shuffle(mine)
         assignments.append((fs, mine))
 
-    # 3. drive each session: send requests, ingest changesets until done
+    # 3. drive each session: requests go out ≤REQUEST_CHUNK needs per turn,
+    # interleaved with response ingestion (ref: round-robin request writer,
+    # peer.rs:1124-1239) — the server starts answering the first turn while
+    # later turns are still being written
     received = 0
 
-    async def drive(fs: FramedStream, mine: Dict[ActorId, list]) -> int:
+    async def drive(fs: FramedStream, mine: List[Tuple[ActorId, object]]) -> int:
         count = 0
         try:
-            reqs = [(a, needs) for a, needs in mine.items() if needs]
-            for i in range(0, len(reqs), REQUEST_CHUNK):
-                await fs.send(wire.encode_sync_request(reqs[i : i + REQUEST_CHUNK]))
+            for i in range(0, len(mine), REQUEST_CHUNK):
+                turn = mine[i : i + REQUEST_CHUNK]
+                by_actor: Dict[ActorId, list] = {}
+                for origin, need in turn:
+                    by_actor.setdefault(origin, []).append(need)
+                await fs.send(wire.encode_sync_request(list(by_actor.items())))
+                await asyncio.sleep(0)  # yield between turns
             await fs.send(wire.pack(("request_fin",)))
             while True:
                 data = await fs.recv(timeout=30.0)

@@ -1,0 +1,230 @@
+"""Port of ``test_handle_known_version`` (api/peer.rs:1576-1771): drive
+the server-side version streaming against a real store with no network —
+current versions, partial (buffered) versions mid-assembly, the
+partial→current FLIP mid-serve (peer.rs:455-506), and the ≤6-concurrent
+version-job pool (peer.rs:680-686)."""
+
+import asyncio
+
+from corrosion_tpu import wire
+from corrosion_tpu.agent import Agent, AgentConfig, make_broadcastable_changes
+from corrosion_tpu.sync.session import (
+    MAX_CONCURRENT_VERSION_JOBS,
+    SyncServer,
+)
+from corrosion_tpu.types.sync_state import SyncNeedFull, SyncNeedPartial
+
+SCHEMA = """
+CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;
+"""
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def mkagent():
+    agent = Agent(AgentConfig(db_path=":memory:", read_conns=1))
+    agent.pool.open()
+    conn = agent.pool._write_conn
+    conn.executescript(SCHEMA)
+    conn.execute("SELECT crsql_as_crr('tests')")
+    return agent.open_sync()
+
+
+class FakeStream:
+    """In-memory FramedStream double: scripted incoming frames, captured
+    outgoing frames (the reference's no-network store-level harness)."""
+
+    def __init__(self, incoming=()):
+        self.sent = []
+        self._in = asyncio.Queue()
+        for f in incoming:
+            self._in.put_nowait(f)
+
+    async def send(self, data: bytes) -> None:
+        self.sent.append(bytes(data))
+
+    async def recv(self, timeout=None):
+        try:
+            return await asyncio.wait_for(self._in.get(), timeout or 5.0)
+        except asyncio.TimeoutError:
+            return None
+
+    def close(self) -> None:
+        pass
+
+
+def sent_changesets(fs: FakeStream):
+    out = []
+    for frame in fs.sent:
+        kind, payload = wire.decode_sync(frame)
+        if kind == "changeset":
+            out.append(payload)
+    return out
+
+
+def test_serve_current_version():
+    async def main():
+        a = mkagent()
+        out = await make_broadcastable_changes(
+            a,
+            [
+                ("INSERT INTO tests (id, text) VALUES (?, ?)", (i, f"v{i}"))
+                for i in range(50)
+            ],
+        )
+        assert out.version == 1
+        server = SyncServer(a)
+        fs = FakeStream()
+        await server._serve_need(
+            fs, a.actor_id, SyncNeedFull(versions=(1, 1)), asyncio.Lock()
+        )
+        sets = sent_changesets(fs)
+        assert sets, "nothing streamed"
+        assert len({c.seq for cv in sets for c in cv.changeset.changes}) == 50
+        # streamed chunks cover the full seq space 0..last_seq
+        assert cv_last(sets) == 49
+        a.close()
+
+    def cv_last(sets):
+        return max(cv.changeset.seqs[1] for cv in sets)
+
+    run(main())
+
+
+def _partial_fixture():
+    """(a, b, chunks): a committed one big chunked version; b buffered all
+    chunks except the first → version 1 is Partial on b."""
+
+    async def make():
+        a, b = mkagent(), mkagent()
+        out = await make_broadcastable_changes(
+            a,
+            [
+                ("INSERT INTO tests (id, text) VALUES (?, ?)", (i, f"val{i}"))
+                for i in range(200)
+            ],
+        )
+        assert len(out.changesets) >= 2
+        await b.process_multiple_changes(out.changesets[1:])
+        assert 1 in b.bookie.get(a.actor_id).versions.partials
+        return a, b, out.changesets
+
+    return make
+
+
+def test_serve_partial_version_mid_assembly():
+    async def main():
+        a, b, chunks = await _partial_fixture()()
+        server = SyncServer(b)
+        fs = FakeStream()
+        have = list(b.bookie.get(a.actor_id).versions.partials[1].seqs)
+        await server._serve_need(
+            fs,
+            a.actor_id,
+            SyncNeedPartial(version=1, seqs=tuple(have)),
+            asyncio.Lock(),
+        )
+        sets = sent_changesets(fs)
+        assert sets
+        served_seqs = {
+            c.seq for cv in sets for c in cv.changeset.changes
+        }
+        expect_seqs = {
+            c.seq for cv in chunks[1:] for c in cv.changeset.changes
+        }
+        assert served_seqs == expect_seqs
+        a.close(), b.close()
+
+    run(main())
+
+
+def test_partial_to_current_flip_is_revalidated():
+    """The flip case (peer.rs:455-506): the need was computed while the
+    version was Partial; by serve time the missing chunk arrived and the
+    version flipped to Current (buffer rows deleted).  The server must
+    observe the flip under the booked write lock and serve the requested
+    seq ranges from ``crsql_changes`` instead of streaming nothing."""
+
+    async def main():
+        a, b, chunks = await _partial_fixture()()
+        stale_need = SyncNeedPartial(
+            version=1,
+            seqs=tuple(b.bookie.get(a.actor_id).versions.partials[1].seqs),
+        )
+        # flip: the missing first chunk arrives, buffer flushes to current
+        await b.process_multiple_changes(chunks[:1])
+        book = b.bookie.get(a.actor_id).versions
+        assert book.contains_current(1) and 1 not in book.partials
+
+        server = SyncServer(b)
+        fs = FakeStream()
+        await server._serve_need(fs, a.actor_id, stale_need, asyncio.Lock())
+        sets = sent_changesets(fs)
+        assert sets, "flip must serve the current version, not nothing"
+        served_seqs = {c.seq for cv in sets for c in cv.changeset.changes}
+        want_seqs = set()
+        for s, e in stale_need.seqs:
+            want_seqs.update(range(s, e + 1))
+        assert served_seqs == want_seqs
+        a.close(), b.close()
+
+    run(main())
+
+
+def test_version_jobs_bounded_concurrency():
+    """Full serve() session with many needs: jobs overlap but never more
+    than MAX_CONCURRENT_VERSION_JOBS at once (peer.rs:680-686)."""
+
+    async def main():
+        a = mkagent()
+        for i in range(20):
+            await make_broadcastable_changes(
+                a,
+                [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, "x"))],
+            )
+        server = SyncServer(a)
+
+        in_flight = 0
+        seen_max = 0
+        orig = server._serve_version
+
+        async def tracked(*args, **kw):
+            nonlocal in_flight, seen_max
+            in_flight += 1
+            seen_max = max(seen_max, in_flight)
+            try:
+                await asyncio.sleep(0.005)  # force overlap
+                return await orig(*args, **kw)
+            finally:
+                in_flight -= 1
+
+        server._serve_version = tracked
+
+        frames = [
+            wire.encode_bi_sync_start(a.actor_id, 0, {}),
+            wire.encode_sync_state(a.generate_sync()),
+            wire.encode_sync_clock(a.clock.new_timestamp()),
+            wire.encode_sync_request(
+                [
+                    (
+                        a.actor_id,
+                        [SyncNeedFull(versions=(v, v)) for v in range(1, 21)],
+                    )
+                ]
+            ),
+            wire.pack(("request_fin",)),
+        ]
+        fs = FakeStream(frames)
+        await server.serve(("127.0.0.1", 1), fs)
+        sets = sent_changesets(fs)
+        assert len(sets) == 20
+        assert seen_max > 1, "version jobs never overlapped"
+        assert seen_max <= MAX_CONCURRENT_VERSION_JOBS
+        # session terminates with done
+        kinds = [wire.decode_sync(f)[0] for f in fs.sent]
+        assert kinds[-1] == "done"
+        a.close()
+
+    run(main())

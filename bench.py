@@ -35,7 +35,19 @@ def main() -> None:
     args = ap.parse_args()
 
     t_all = time.perf_counter()
+    import os
+
     import jax
+
+    # persistent compilation cache: repeat runs measure marginal cost
+    # honestly instead of re-paying XLA compilation every time (compile_s
+    # in the output shows which case this run was)
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     from corrosion_tpu.sim import cluster, crdt, model, reference
 

@@ -1,0 +1,189 @@
+"""Bootstrap address resolution + persisted-member fallback.
+
+Equivalent of crates/corro-agent/src/agent/bootstrap.rs:14-56
+(``generate_bootstrap``): a bootstrap spec is one of
+
+- ``ip:port``                 — used as-is (v4, or bracketed v6)
+- ``host:port``               — resolved A/AAAA via the system resolver
+- ``host:port@dns-server``    — resolved against a SPECIFIC DNS server
+  (the reference builds a trust-dns resolver pointed at that server;
+  here a minimal stdlib DNS/UDP client does the one query type needed)
+
+When nothing resolves (empty list, dead DNS, bad hostnames), the agent
+falls back to up to :data:`FALLBACK_CHOICES` random rows persisted in
+``__corro_members`` (bootstrap.rs:44-56) — a restarted node whose
+configured bootstrap peers are gone rejoins the cluster it already knew.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import ipaddress
+import random
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+Addr = Tuple[str, int]
+
+FALLBACK_CHOICES = 5  # ref: bootstrap.rs:47 (5 random persisted members)
+DNS_TIMEOUT = 2.0
+
+QTYPE_A = 1
+QTYPE_AAAA = 28
+
+
+def parse_spec(spec: str) -> Tuple[str, int, Optional[Addr]]:
+    """``host:port[@dns[:dnsport]]`` → (host, port, dns_addr|None)."""
+    dns: Optional[Addr] = None
+    if "@" in spec:
+        spec, _, dns_part = spec.partition("@")
+        dhost, _, dport = dns_part.rpartition(":")
+        if dhost:
+            dns = (dhost, int(dport))
+        else:
+            dns = (dns_part, 53)
+    host, _, port = spec.rpartition(":")
+    if not host:
+        raise ValueError(f"bootstrap spec needs host:port, got {spec!r}")
+    host = host.strip("[]")  # bracketed v6
+    return host, int(port), dns
+
+
+def _encode_query(txid: int, name: str, qtype: int) -> bytes:
+    out = struct.pack(">HHHHHH", txid, 0x0100, 1, 0, 0, 0)
+    for label in name.rstrip(".").split("."):
+        raw = label.encode("idna") if label else b""
+        out += struct.pack(">B", len(raw)) + raw
+    out += b"\x00" + struct.pack(">HH", qtype, 1)  # IN
+    return out
+
+
+def _skip_name(data: bytes, off: int) -> int:
+    """Offset just past a (possibly compressed) DNS name; loop-guarded."""
+    for _ in range(128):
+        if off >= len(data):
+            raise ValueError("truncated name")
+        n = data[off]
+        if n == 0:
+            return off + 1
+        if n & 0xC0 == 0xC0:
+            return off + 2
+        off += 1 + n
+    raise ValueError("name too long")
+
+
+def _parse_answers(data: bytes, txid: int, qtype: int) -> List[str]:
+    if len(data) < 12:
+        raise ValueError("short dns response")
+    rid, flags, qd, an, _ns, _ar = struct.unpack(">HHHHHH", data[:12])
+    if rid != txid or not flags & 0x8000:
+        raise ValueError("bad dns response")
+    off = 12
+    for _ in range(qd):
+        off = _skip_name(data, off) + 4
+    out: List[str] = []
+    for _ in range(an):
+        off = _skip_name(data, off)
+        if off + 10 > len(data):
+            raise ValueError("truncated answer")
+        rtype, _rclass, _ttl, rdlen = struct.unpack(
+            ">HHIH", data[off : off + 10]
+        )
+        off += 10
+        rdata = data[off : off + rdlen]
+        off += rdlen
+        if rtype == qtype == QTYPE_A and rdlen == 4:
+            out.append(str(ipaddress.IPv4Address(rdata)))
+        elif rtype == qtype == QTYPE_AAAA and rdlen == 16:
+            out.append(str(ipaddress.IPv6Address(rdata)))
+    return out
+
+
+class _DnsProto(asyncio.DatagramProtocol):
+    def __init__(self) -> None:
+        self.response: asyncio.Future = asyncio.get_running_loop().create_future()
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        if not self.response.done():
+            self.response.set_result(data)
+
+    def error_received(self, exc) -> None:
+        if not self.response.done():
+            self.response.set_exception(exc)
+
+
+async def dns_resolve(
+    name: str, server: Addr, qtype: int = QTYPE_A, timeout: float = DNS_TIMEOUT
+) -> List[str]:
+    """One A/AAAA query against a specific DNS server (UDP)."""
+    txid = random.randrange(1, 0xFFFF)
+    query = _encode_query(txid, name, qtype)
+    loop = asyncio.get_running_loop()
+    transport, proto = await loop.create_datagram_endpoint(
+        _DnsProto, remote_addr=server
+    )
+    try:
+        transport.sendto(query)
+        data = await asyncio.wait_for(proto.response, timeout)
+        return _parse_answers(data, txid, qtype)
+    finally:
+        transport.close()
+
+
+async def resolve_spec(spec: str) -> List[Addr]:
+    """All addresses one bootstrap spec resolves to (empty on failure)."""
+    try:
+        host, port, dns = parse_spec(spec)
+    except ValueError:
+        return []
+    with contextlib.suppress(ValueError):
+        ipaddress.ip_address(host)
+        return [(host, port)]
+    if dns is not None:
+        addrs: List[Addr] = []
+        for qtype in (QTYPE_A, QTYPE_AAAA):
+            with contextlib.suppress(Exception):
+                addrs.extend(
+                    (ip, port) for ip in await dns_resolve(host, dns, qtype)
+                )
+        return addrs
+    # system resolver (A/AAAA per local stack, ref: bootstrap.rs:24-40)
+    try:
+        infos = await asyncio.get_running_loop().getaddrinfo(
+            host, port, type=socket.SOCK_DGRAM
+        )
+    except OSError:
+        return []
+    return list({(info[4][0], port) for info in infos})
+
+
+async def generate_bootstrap(
+    specs: List[str], our_addr: Addr, pool
+) -> List[Addr]:
+    """Resolve all specs; on a completely dead list fall back to up to 5
+    random persisted ``__corro_members`` addresses (bootstrap.rs:44-56)."""
+    addrs: List[Addr] = []
+    for spec in specs:
+        addrs.extend(await resolve_spec(spec))
+    addrs = [a for a in dict.fromkeys(addrs) if a != our_addr]
+    if addrs:
+        return addrs
+
+    def _read(conn):
+        return [
+            r[0]
+            for r in conn.execute(
+                "SELECT address FROM __corro_members"
+            ).fetchall()
+        ]
+
+    persisted = []
+    for address in await pool.read_call(_read):
+        with contextlib.suppress(ValueError):
+            host, _, port = address.rpartition(":")
+            if host and (host, int(port)) != our_addr:
+                persisted.append((host, int(port)))
+    random.shuffle(persisted)
+    return persisted[:FALLBACK_CHOICES]

@@ -22,6 +22,7 @@ from .agent import Agent
 APPLY_QUEUE_LEN = 600  # ref: handlers.rs apply_queue_len default
 FLUSH_INTERVAL = 0.05  # ref: handlers.rs 50ms flush tick
 SEEN_CACHE_SIZE = 10_000  # ref: handlers.rs seen dedup cache of 10k
+MAX_CONCURRENT_APPLIES = 5  # ref: handlers.rs:408-446 (≤5 apply jobs)
 
 
 class ChangeIngest:
@@ -46,6 +47,11 @@ class ChangeIngest:
         self._seen: "OrderedDict[tuple, None]" = OrderedDict()
         self._task: Optional[asyncio.Task] = None
         self._processing = False
+        # ≤5 concurrent apply jobs (ref: handlers.rs:408-446): batches for
+        # disjoint actors overlap — per-actor booked write locks inside
+        # process_multiple_changes serialize same-actor batches safely
+        self._apply_sem = asyncio.Semaphore(MAX_CONCURRENT_APPLIES)
+        self._apply_tasks: set = set()
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._run())
@@ -55,6 +61,12 @@ class ChangeIngest:
             self._task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
                 await self._task
+        # drain in-flight apply jobs so their write transactions finish
+        # cleanly before the pool closes
+        if self._apply_tasks:
+            await asyncio.gather(
+                *self._apply_tasks, return_exceptions=True
+            )
 
     async def submit(self, change: ChangeV1, source: str) -> None:
         await self.queue.put((change, source))
@@ -74,9 +86,14 @@ class ChangeIngest:
 
     @property
     def idle(self) -> bool:
-        """True when nothing is queued or mid-batch — the quiescence
-        signal harness.DevCluster.settle polls in round-paced mode."""
-        return self.queue.empty() and not self._processing
+        """True when nothing is queued, mid-collection, or mid-apply — the
+        quiescence signal harness.DevCluster.settle polls in round-paced
+        mode."""
+        return (
+            self.queue.empty()
+            and not self._processing
+            and not self._apply_tasks
+        )
 
     async def _run(self) -> None:
         while True:
@@ -97,14 +114,25 @@ class ChangeIngest:
                         )
                     except asyncio.TimeoutError:
                         break
-                try:
-                    await self._process_batch(batch)
-                except Exception:
-                    logging.getLogger(__name__).exception(
-                        "change batch failed; will be retried via sync"
-                    )
+                # dispatch as a bounded concurrent job: acquiring the
+                # semaphore BEFORE create_task keeps the job count itself
+                # capped (backpressure reaches the queue when 5 are busy)
+                await self._apply_sem.acquire()
+                t = asyncio.create_task(self._apply_job(batch))
+                self._apply_tasks.add(t)
+                t.add_done_callback(self._apply_tasks.discard)
             finally:
                 self._processing = False
+
+    async def _apply_job(self, batch: List[Tuple[ChangeV1, str]]) -> None:
+        try:
+            await self._process_batch(batch)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "change batch failed; will be retried via sync"
+            )
+        finally:
+            self._apply_sem.release()
 
     async def _process_batch(self, batch: List[Tuple[ChangeV1, str]]) -> None:
         to_apply: List[ChangeV1] = []

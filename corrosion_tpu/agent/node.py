@@ -206,6 +206,7 @@ class Node:
                 self.agent,
                 broadcast_hook=lambda changes: self.broadcast.enqueue(changes),
                 subs=self.subs,
+                password=self.config.api.pg_password,
             )
             await self.pg.start(pg_host, pg_port)
 
@@ -236,6 +237,13 @@ class Node:
         self._tasks.append(asyncio.create_task(self._swim_loop()))
         if not self.config.perf.manual_pacing:
             self._tasks.append(asyncio.create_task(self._sync_loop()))
+        if self.config.perf.compact_interval > 0:
+            self._tasks.append(asyncio.create_task(self._compact_loop()))
+        if (
+            self.config.perf.wal_truncate_interval > 0
+            and self.config.db.path != ":memory:"
+        ):
+            self._tasks.append(asyncio.create_task(self._wal_truncate_loop()))
         self._tasks.append(asyncio.create_task(self._persist_members_loop()))
         self._tasks.append(asyncio.create_task(self._announce_loop()))
         if self.config.telemetry.prometheus_addr:
@@ -330,15 +338,29 @@ class Node:
                 break
 
     async def _announce_loop(self) -> None:
-        """Bootstrap announcements with backoff (ref: handlers.rs:178-222 +
-        bootstrap.rs)."""
+        """Bootstrap announcements with backoff (ref: handlers.rs:178-222):
+        specs are resolved through agent/bootstrap.py (ip / system DNS /
+        ``host:port@dns-server``), and a node whose whole bootstrap list is
+        dead announces to random persisted ``__corro_members`` addresses
+        instead (bootstrap.rs:44-56) — so a restart rejoins the cluster it
+        already knew even with stale configuration."""
+        from .bootstrap import generate_bootstrap
+
         assert self.swim is not None
         backoff = ANNOUNCE_BACKOFF_MIN
         while True:
             if not self.members.up_members():
-                for spec in self.config.gossip.bootstrap:
-                    with contextlib.suppress(ValueError):
-                        self.swim.announce(parse_addr(spec))
+                try:
+                    addrs = await generate_bootstrap(
+                        self.config.gossip.bootstrap,
+                        self.gossip_addr,
+                        self.agent.pool,
+                    )
+                except Exception:
+                    logger.exception("bootstrap resolution failed")
+                    addrs = []
+                for addr in addrs:
+                    self.swim.announce(addr)
                 await self._pump_swim()
                 await asyncio.sleep(backoff + random.uniform(0, 1))
                 # backoff escalates only across consecutive isolated rounds
@@ -346,6 +368,47 @@ class Node:
             else:
                 backoff = ANNOUNCE_BACKOFF_MIN
                 await asyncio.sleep(ANNOUNCE_BACKOFF_MIN)
+
+    async def _compact_loop(self) -> None:
+        """Periodic overwritten-version compaction (ref:
+        clear_overwritten_versions, util.rs:153-348, run from the task tree
+        at run_root.rs:213).  Empty changesets themselves are stored inline
+        at apply time (store_empty_changeset in agent/apply.py) — the
+        reference's separate write_empties_loop (util.rs:746-804) is a
+        batching optimization over the same bookkeeping writes; this loop
+        supplies the part that would otherwise never run: folding fully
+        overwritten db versions into cleared ranges so a long-running
+        node's bookkeeping doesn't grow without bound."""
+        from ..utils.metrics import counter
+
+        while True:
+            await asyncio.sleep(self.config.perf.compact_interval)
+            try:
+                cleared = await self.agent.compact_empties()
+                n = sum(len(v) for v in cleared.values())
+                if n:
+                    counter("corro.db.versions.compacted").inc(n)
+            except Exception:
+                logger.exception("compaction pass failed")
+
+    async def _wal_truncate_loop(self) -> None:
+        """Periodic WAL checkpoint+truncate (ref: spawn_handle_db_cleanup,
+        run_root.rs:111-129: TRUNCATE checkpoint every 15 min) so the WAL
+        file can't grow unboundedly under sustained writes."""
+        from .pool import PRIORITY_LOW
+
+        while True:
+            await asyncio.sleep(self.config.perf.wal_truncate_interval)
+            try:
+                busy = await self.agent.pool.write_call(
+                    lambda c: c.execute(
+                        "PRAGMA wal_checkpoint(TRUNCATE)"
+                    ).fetchone(),
+                    priority=PRIORITY_LOW,
+                )
+                logger.debug("wal truncate: %s", busy)
+            except Exception:
+                logger.exception("wal truncate failed")
 
     async def _persist_members_loop(self) -> None:
         """Persist membership every 60 s (ref: broadcast/mod.rs:602-734)."""

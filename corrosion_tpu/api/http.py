@@ -76,6 +76,7 @@ class Api:
         broadcast_hook: Optional[Callable] = None,
         authz_token: Optional[str] = None,
         subs=None,
+        concurrency_limit: int = 128,
     ) -> None:
         self.agent = agent
         # called with the list of ChangeV1 produced by a local commit, so the
@@ -83,13 +84,20 @@ class Api:
         self.broadcast_hook = broadcast_hook
         self.authz_token = authz_token
         self.subs = subs  # SubsManager; local commits notify it directly
+        # ref: util.rs:399-485 — every /v1 route is concurrency-limited
+        # (128) with load-shedding: excess load is REJECTED with 503, not
+        # queued unboundedly behind the write semaphore
+        self.concurrency_limit = concurrency_limit
+        self._inflight = 0
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
 
     # -- app wiring -------------------------------------------------------
 
     def build_app(self) -> web.Application:
-        app = web.Application(middlewares=[self._auth_middleware])
+        app = web.Application(
+            middlewares=[self._shed_middleware, self._auth_middleware]
+        )
         app.router.add_post("/v1/transactions", self.tx_handler)
         app.router.add_post("/v1/queries", self.query_handler)
         app.router.add_post("/v1/migrations", self.migrations_handler)
@@ -99,6 +107,29 @@ class Api:
 
             SubsApi(self.subs).register(app)
         return app
+
+    @web.middleware
+    async def _shed_middleware(self, request: web.Request, handler):
+        """Load shedding (ref: util.rs:399-485: ConcurrencyLimitLayer +
+        LoadShedLayer per route → 503 under overload).  Subscription
+        streams are exempt: they stay open for the subscription's
+        lifetime, and counting them would let normal steady-state
+        subscribers permanently starve the request/response routes (the
+        reference's limits are per-route for the same reason)."""
+        if request.path.startswith("/v1/subscriptions"):
+            return await handler(request)
+        if self._inflight >= self.concurrency_limit:
+            from ..utils.metrics import counter
+
+            counter("corro.api.shed").inc()
+            return web.json_response(
+                {"error": "service overloaded"}, status=503
+            )
+        self._inflight += 1
+        try:
+            return await handler(request)
+        finally:
+            self._inflight -= 1
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):

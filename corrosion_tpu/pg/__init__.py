@@ -70,6 +70,67 @@ class PgProtocolError(Exception):
     pass
 
 
+_QUALIFIER_RE = re.compile(r"\b(pg_catalog|information_schema)\.")
+
+
+def _rewrite_code(sql: str, fn) -> str:
+    """Apply ``fn`` to the CODE runs of ``sql`` only — quoted literals
+    and comments pass through untouched (the module invariant: rewrites
+    never alter string data)."""
+    return "".join(
+        fn(text) if kind == CODE else text for text, kind in _scan(sql)
+    )
+
+
+def _catalog_query(conn, raw_sql: str, params: Tuple):
+    """Run one introspection query against a catalog DB freshly derived
+    from ``conn``'s schema (pg/catalog.py).  ``'name'::regclass`` casts
+    become pg_class oid lookups BEFORE generic cast-stripping, and the
+    ``pg_catalog.`` / ``information_schema.`` qualifiers drop away (the
+    catalog DB's tables carry the bare names).  Both rewrites are
+    quote-aware: the regclass pattern anchors on the cast token in CODE
+    position (the quoted name it consumes is part of the cast
+    expression), and the qualifier strip maps over CODE runs only."""
+    from .catalog import build_catalog
+
+    # regclass casts: rewrite only where the '::regclass' token sits in
+    # code — scan runs, and only join a QUOTED run with a following CODE
+    # run when the code run starts with the cast
+    runs = _scan(raw_sql)
+    parts: List[str] = []
+    i = 0
+    cast_re = re.compile(r"^\s*::\s*regclass\b")
+    while i < len(runs):
+        text, kind = runs[i]
+        nxt = runs[i + 1] if i + 1 < len(runs) else None
+        if (
+            kind == QUOTED
+            and text[0] == "'"
+            and nxt is not None
+            and nxt[1] == CODE
+            and cast_re.search(nxt[0])
+        ):
+            name = text[1:-1].replace("''", "'").split(".")[-1]
+            safe = name.replace("'", "''")
+            parts.append(
+                f"(SELECT oid FROM pg_class WHERE relname = '{safe}')"
+            )
+            parts.append(cast_re.sub("", nxt[0]))
+            i += 2
+            continue
+        parts.append(text)
+        i += 1
+    sql = translate_sql("".join(parts))
+    sql = _rewrite_code(sql, lambda seg: _QUALIFIER_RE.sub("", seg))
+    cat = build_catalog(conn)
+    try:
+        cur = cat.execute(sql, params)
+        desc = [d[0] for d in cur.description] if cur.description else []
+        return desc, cur.fetchall()
+    finally:
+        cat.close()
+
+
 # -- SQL translation --------------------------------------------------------
 
 _PARAM_RE = re.compile(r"\$(\d+)")
@@ -88,14 +149,102 @@ _PG_CATALOG_RE = re.compile(
 )
 
 
+CODE, QUOTED, COMMENT = 0, 1, 2
+
+
+def _scan(sql: str) -> List[Tuple[str, int]]:
+    """Lex SQL into (text, kind) runs — kind is CODE, QUOTED (delimiters
+    included, ``''`` escaping honored) or COMMENT (``--`` to end of line,
+    nesting ``/* */`` as PostgreSQL defines them).  Every rewrite and the
+    statement splitter work over these runs so string data is never
+    rewritten and comment contents can't be mistaken for code (ADVICE r2:
+    comment-blind splitting broke on ``;`` inside comments)."""
+    runs: List[Tuple[str, int]] = []
+    buf: List[str] = []
+    state = CODE
+    quote: Optional[str] = None
+    depth = 0
+    i, n = 0, len(sql)
+
+    def flush(kind: int) -> None:
+        nonlocal buf
+        if buf:
+            runs.append(("".join(buf), kind))
+            buf = []
+
+    while i < n:
+        ch = sql[i]
+        nxt = sql[i + 1] if i + 1 < n else ""
+        if state == CODE:
+            if ch in ("'", '"'):
+                flush(CODE)
+                buf.append(ch)
+                quote = ch
+                state = QUOTED
+            elif ch == "-" and nxt == "-":
+                flush(CODE)
+                buf.append("--")
+                i += 1
+                state = 3  # line comment
+            elif ch == "/" and nxt == "*":
+                flush(CODE)
+                buf.append("/*")
+                i += 1
+                depth = 1
+                state = 4  # block comment
+            else:
+                buf.append(ch)
+        elif state == QUOTED:
+            buf.append(ch)
+            if ch == quote:
+                if nxt == quote:
+                    buf.append(nxt)
+                    i += 1
+                else:
+                    flush(QUOTED)
+                    state = CODE
+        elif state == 3:  # line comment
+            buf.append(ch)
+            if ch == "\n":
+                flush(COMMENT)
+                state = CODE
+        else:  # block comment (nests, as in PG)
+            if ch == "*" and nxt == "/":
+                buf.append("*/")
+                i += 1
+                depth -= 1
+                if depth == 0:
+                    flush(COMMENT)
+                    state = CODE
+            elif ch == "/" and nxt == "*":
+                buf.append("/*")
+                i += 1
+                depth += 1
+            else:
+                buf.append(ch)
+        i += 1
+    flush(COMMENT if state in (3, 4) else QUOTED if state == QUOTED else CODE)
+    return runs
+
+
+def strip_comments(sql: str) -> str:
+    """Comments → one space (classification and translation must never
+    see comment text as code; SQLite also rejects PG's nested blocks)."""
+    return "".join(
+        " " if kind == COMMENT else text for text, kind in _scan(sql)
+    )
+
+
 def translate_sql(sql: str) -> str:
     """PG dialect → SQLite: ``$N`` params and ``::cast`` stripping,
     applied only OUTSIDE string literals so data is never rewritten
-    (ref: corro-pg's sqlparser translation pass)."""
+    (ref: corro-pg's sqlparser translation pass); comments are dropped."""
     out: List[str] = []
-    for segment, quoted in _segments(sql):
-        if quoted:
+    for segment, kind in _scan(sql):
+        if kind == QUOTED:
             out.append(segment)
+        elif kind == COMMENT:
+            out.append(" ")
         else:
             segment = _PARAM_RE.sub(lambda m: f"?{m.group(1)}", segment)
             segment = _CAST_RE.sub("", segment)
@@ -103,74 +252,31 @@ def translate_sql(sql: str) -> str:
     return "".join(out)
 
 
-def _segments(sql: str) -> List[Tuple[str, bool]]:
-    """Split SQL into (text, is_quoted) runs; quoted runs include their
-    delimiters and honor '' escaping."""
-    runs: List[Tuple[str, bool]] = []
-    buf: List[str] = []
-    quote: Optional[str] = None
-    i = 0
-    while i < len(sql):
-        ch = sql[i]
-        if quote is None:
-            if ch in ("'", '"'):
-                if buf:
-                    runs.append(("".join(buf), False))
-                buf = [ch]
-                quote = ch
-            else:
-                buf.append(ch)
-        else:
-            buf.append(ch)
-            if ch == quote:
-                if i + 1 < len(sql) and sql[i + 1] == quote:
-                    buf.append(sql[i + 1])
-                    i += 1
-                else:
-                    runs.append(("".join(buf), True))
-                    buf = []
-                    quote = None
-        i += 1
-    if buf:
-        runs.append(("".join(buf), quote is not None))
-    return runs
-
-
 def split_statements(script: str) -> List[str]:
-    """Split a simple-query script on ``;`` outside quotes."""
+    """Split a simple-query script on ``;`` outside quotes AND comments."""
     out: List[str] = []
     buf: List[str] = []
-    quote: Optional[str] = None
-    i = 0
-    while i < len(script):
-        ch = script[i]
-        if quote is not None:
-            buf.append(ch)
-            if ch == quote:
-                if i + 1 < len(script) and script[i + 1] == quote:
-                    buf.append(script[i + 1])
-                    i += 1
-                else:
-                    quote = None
-        elif ch in ("'", '"'):
-            quote = ch
-            buf.append(ch)
-        elif ch == ";":
+    for text, kind in _scan(script):
+        if kind != CODE:
+            buf.append(text)
+            continue
+        while ";" in text:
+            part, _, text = text.partition(";")
+            buf.append(part)
             stmt = "".join(buf).strip()
-            if stmt:
+            if stmt and strip_comments(stmt).strip():
                 out.append(stmt)
             buf = []
-        else:
-            buf.append(ch)
-        i += 1
+        buf.append(text)
     stmt = "".join(buf).strip()
-    if stmt:
+    if stmt and strip_comments(stmt).strip():
         out.append(stmt)
     return out
 
 
 def classify(sql: str) -> str:
     """'read' | 'write' | 'begin' | 'commit' | 'rollback' | 'set' | 'show'."""
+    sql = strip_comments(sql)
     head = sql.lstrip().split(None, 1)
     word = head[0].lower() if head else ""
     if word == "begin" or word == "start":
@@ -217,7 +323,7 @@ def _with_is_write(sql: str) -> bool:
 
 
 def command_tag(sql: str, rowcount: int) -> str:
-    head = sql.lstrip().split(None, 2)
+    head = strip_comments(sql).lstrip().split(None, 2)
     word = head[0].upper() if head else "OK"
     if word == "SELECT":
         return f"SELECT {rowcount}"
@@ -401,10 +507,15 @@ class PgServer:
         agent: Agent,
         broadcast_hook=None,
         subs=None,
+        password: Optional[str] = None,
     ) -> None:
         self.agent = agent
         self.broadcast_hook = broadcast_hook
         self.subs = subs
+        # cleartext password auth when set (ADVICE r2: the listener was
+        # wide open; run it behind TLS/a private network — cleartext is
+        # what the v3 protocol offers without SCRAM state)
+        self.password = password
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: set = set()
         self.port: Optional[int] = None
@@ -473,6 +584,23 @@ class PgServer:
             if k:
                 params[k.decode()] = v.decode()
         logger.debug("pg startup: %s", params)
+        if self.password is not None:
+            out.message(b"R", struct.pack("!I", 3))  # CleartextPassword
+            await writer.drain()
+            kind = await reader.readexactly(1)
+            (length,) = struct.unpack("!I", await reader.readexactly(4))
+            body = await reader.readexactly(length - 4)
+            supplied = body.rstrip(b"\x00").decode(errors="replace")
+            if kind != b"p" or not secrets.compare_digest(
+                supplied, self.password
+            ):
+                out.error(
+                    f"password authentication failed for user "
+                    f"\"{params.get('user', '')}\"",
+                    "28P01",
+                )
+                await writer.drain()
+                return False
         out.auth_ok()
         for key, value in (
             ("server_version", "14.0 (corrosion-tpu)"),
@@ -659,11 +787,18 @@ class PgServer:
         describe_rows: bool,
     ) -> None:
         if _PG_CATALOG_RE.search(sql):
-            # pg_catalog shim: empty result (the reference implements
-            # real vtabs; clients mostly tolerate empty introspection)
+            # real catalog emulation (ref: corro-pg/src/vtab/): the query
+            # runs against an in-memory catalog DB rebuilt from the live
+            # SQLite schema, so psql/psycopg introspection sees actual
+            # tables and columns
+            desc, rows = await self.agent.pool.read_call(
+                lambda conn: _catalog_query(conn, raw_sql, params)
+            )
             if describe_rows:
-                out.row_description([("?column?", OID_TEXT)])
-            out.command_complete("SELECT 0")
+                out.row_description(self._column_oids(desc, rows))
+            for row in rows:
+                out.data_row(row)
+            out.command_complete(command_tag(raw_sql, len(rows)))
             return
         if re.fullmatch(r"\s*select\s+version\s*\(\s*\)\s*;?\s*", sql, re.I):
             if describe_rows:

@@ -1,0 +1,336 @@
+"""pg_catalog emulation derived from the live SQLite schema.
+
+Equivalent of crates/corro-pg/src/vtab/ (pg_type.rs, pg_class.rs,
+pg_namespace.rs, pg_database.rs, pg_range.rs): the reference exposes
+real catalog virtual tables over its store so introspecting clients
+(psql ``\\d``, psycopg, ORMs) see actual tables and columns.  Here the
+catalog is a throwaway in-memory SQLite database rebuilt from
+``sqlite_master`` on demand: catalog queries — arbitrary SELECTs joining
+pg_class/pg_namespace/pg_attribute/... — run against it unchanged, which
+costs far less than a SQL rewriter and keeps the main store untouched.
+
+OID scheme: namespaces and built-in types use their real PostgreSQL
+OIDs (clients hard-code e.g. 25 = text); relations get 16384+i (the
+user-object range) ordered by ``sqlite_master`` rowid, columns use
+(attrelid, attnum).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import List, Tuple
+
+OID_PG_CATALOG = 11
+OID_PUBLIC = 2200
+FIRST_REL_OID = 16384
+
+# (oid, typname, typlen, typtype, typcategory, typarray-oid)
+BUILTIN_TYPES: List[Tuple[int, str, int, str, str, int]] = [
+    (16, "bool", 1, "b", "B", 1000),
+    (17, "bytea", -1, "b", "U", 1001),
+    (18, "char", 1, "b", "Z", 1002),
+    (19, "name", 64, "b", "S", 1003),
+    (20, "int8", 8, "b", "N", 1016),
+    (21, "int2", 2, "b", "N", 1005),
+    (23, "int4", 4, "b", "N", 1007),
+    (24, "regproc", 4, "b", "N", 1008),
+    (25, "text", -1, "b", "S", 1009),
+    (26, "oid", 4, "b", "N", 1028),
+    (700, "float4", 4, "b", "N", 1021),
+    (701, "float8", 8, "b", "N", 1022),
+    (1042, "bpchar", -1, "b", "S", 1014),
+    (1043, "varchar", -1, "b", "S", 1015),
+    (1082, "date", 4, "b", "D", 1182),
+    (1114, "timestamp", 8, "b", "D", 1115),
+    (1184, "timestamptz", 8, "b", "D", 1185),
+    (1700, "numeric", -1, "b", "N", 1231),
+    (2205, "regclass", 4, "b", "N", 2210),
+    (3802, "jsonb", -1, "b", "U", 3807),
+    (114, "json", -1, "b", "U", 199),
+]
+
+_DDL = """
+CREATE TABLE pg_namespace (
+    oid INTEGER PRIMARY KEY, nspname TEXT, nspowner INTEGER, nspacl TEXT);
+CREATE TABLE pg_type (
+    oid INTEGER PRIMARY KEY, typname TEXT, typnamespace INTEGER,
+    typowner INTEGER, typlen INTEGER, typbyval INTEGER, typtype TEXT,
+    typcategory TEXT, typispreferred INTEGER, typisdefined INTEGER,
+    typdelim TEXT, typrelid INTEGER, typelem INTEGER, typarray INTEGER,
+    typbasetype INTEGER, typtypmod INTEGER, typnotnull INTEGER,
+    typinput TEXT, typoutput TEXT, typdefault TEXT);
+CREATE TABLE pg_class (
+    oid INTEGER PRIMARY KEY, relname TEXT, relnamespace INTEGER,
+    reltype INTEGER, reloftype INTEGER, relowner INTEGER, relam INTEGER,
+    relfilenode INTEGER, reltablespace INTEGER, relpages INTEGER,
+    reltuples REAL, relallvisible INTEGER, reltoastrelid INTEGER,
+    relhasindex INTEGER, relisshared INTEGER, relpersistence TEXT,
+    relkind TEXT, relnatts INTEGER, relchecks INTEGER,
+    relhasrules INTEGER, relhastriggers INTEGER, relhassubclass INTEGER,
+    relrowsecurity INTEGER, relforcerowsecurity INTEGER,
+    relispopulated INTEGER, relreplident TEXT, relispartition INTEGER,
+    relrewrite INTEGER, relfrozenxid INTEGER, relminmxid INTEGER,
+    relacl TEXT, reloptions TEXT, relpartbound TEXT);
+CREATE TABLE pg_attribute (
+    attrelid INTEGER, attname TEXT, atttypid INTEGER,
+    attstattarget INTEGER, attlen INTEGER, attnum INTEGER,
+    attndims INTEGER, attcacheoff INTEGER, atttypmod INTEGER,
+    attbyval INTEGER, attalign TEXT, attstorage TEXT,
+    attcompression TEXT, attnotnull INTEGER, atthasdef INTEGER,
+    atthasmissing INTEGER, attidentity TEXT, attgenerated TEXT,
+    attisdropped INTEGER, attislocal INTEGER, attinhcount INTEGER,
+    attcollation INTEGER, attacl TEXT, attoptions TEXT,
+    attfdwoptions TEXT, attmissingval TEXT,
+    PRIMARY KEY (attrelid, attnum));
+CREATE TABLE pg_database (
+    oid INTEGER PRIMARY KEY, datname TEXT, datdba INTEGER,
+    encoding INTEGER, datlocprovider TEXT, datistemplate INTEGER,
+    datallowconn INTEGER, datconnlimit INTEGER, datfrozenxid INTEGER,
+    datminmxid INTEGER, dattablespace INTEGER, datcollate TEXT,
+    datctype TEXT, daticulocale TEXT, datcollversion TEXT, datacl TEXT);
+CREATE TABLE pg_range (
+    rngtypid INTEGER PRIMARY KEY, rngsubtype INTEGER, rngmultitypid INTEGER,
+    rngcollation INTEGER, rngsubopc INTEGER, rngcanonical TEXT,
+    rngsubdiff TEXT);
+CREATE TABLE pg_index (
+    indexrelid INTEGER PRIMARY KEY, indrelid INTEGER, indnatts INTEGER,
+    indnkeyatts INTEGER, indisunique INTEGER, indisprimary INTEGER,
+    indisexclusion INTEGER, indimmediate INTEGER, indisclustered INTEGER,
+    indisvalid INTEGER, indcheckxmin INTEGER, indisready INTEGER,
+    indislive INTEGER, indisreplident INTEGER, indkey TEXT,
+    indcollation TEXT, indclass TEXT, indoption TEXT, indexprs TEXT,
+    indpred TEXT);
+CREATE TABLE pg_constraint (
+    oid INTEGER PRIMARY KEY, conname TEXT, connamespace INTEGER,
+    contype TEXT, condeferrable INTEGER, condeferred INTEGER,
+    convalidated INTEGER, conrelid INTEGER, contypid INTEGER,
+    conindid INTEGER, conparentid INTEGER, confrelid INTEGER,
+    confupdtype TEXT, confdeltype TEXT, confmatchtype TEXT,
+    conislocal INTEGER, coninhcount INTEGER, connoinherit INTEGER,
+    conkey TEXT, confkey TEXT, conbin TEXT);
+CREATE TABLE pg_proc (
+    oid INTEGER PRIMARY KEY, proname TEXT, pronamespace INTEGER,
+    proowner INTEGER, prolang INTEGER, prorettype INTEGER,
+    pronargs INTEGER, proargtypes TEXT, prosrc TEXT);
+CREATE TABLE pg_description (
+    objoid INTEGER, classoid INTEGER, objsubid INTEGER, description TEXT);
+CREATE TABLE pg_am (
+    oid INTEGER PRIMARY KEY, amname TEXT, amhandler TEXT, amtype TEXT);
+CREATE TABLE pg_roles (
+    oid INTEGER PRIMARY KEY, rolname TEXT, rolsuper INTEGER,
+    rolinherit INTEGER, rolcreaterole INTEGER, rolcreatedb INTEGER,
+    rolcanlogin INTEGER, rolreplication INTEGER, rolconnlimit INTEGER,
+    rolpassword TEXT, rolvaliduntil TEXT, rolbypassrls INTEGER,
+    rolconfig TEXT);
+CREATE TABLE pg_settings (
+    name TEXT PRIMARY KEY, setting TEXT, unit TEXT, category TEXT,
+    short_desc TEXT, context TEXT, vartype TEXT, source TEXT);
+-- information_schema.{tables,columns}: the qualifier is stripped by the
+-- catalog query rewriter, so the bare names serve both spellings
+CREATE VIEW tables AS
+    SELECT 'corrosion' AS table_catalog, n.nspname AS table_schema,
+           c.relname AS table_name,
+           CASE c.relkind WHEN 'v' THEN 'VIEW' ELSE 'BASE TABLE' END
+               AS table_type
+    FROM pg_class c JOIN pg_namespace n ON n.oid = c.relnamespace
+    WHERE c.relkind IN ('r', 'v');
+CREATE VIEW columns AS
+    SELECT 'corrosion' AS table_catalog, 'public' AS table_schema,
+           c.relname AS table_name, a.attname AS column_name,
+           a.attnum AS ordinal_position,
+           CASE a.attnotnull WHEN 1 THEN 'NO' ELSE 'YES' END AS is_nullable,
+           format_type(a.atttypid) AS data_type
+    FROM pg_attribute a JOIN pg_class c ON c.oid = a.attrelid
+    WHERE a.attnum > 0 AND c.relkind IN ('r', 'v');
+"""
+
+# SQLite declared type → PG type oid (affinity-based fallback)
+_TYPE_MAP = [
+    ("INT", 20),  # int8: SQLite integers are 64-bit
+    ("CHAR", 25),
+    ("CLOB", 25),
+    ("TEXT", 25),
+    ("BLOB", 17),
+    ("REAL", 701),
+    ("FLOA", 701),
+    ("DOUB", 701),
+    ("BOOL", 16),
+    ("NUM", 1700),
+    ("DATE", 1082),
+    ("TIME", 1114),
+    ("JSON", 114),
+]
+
+
+def sqlite_type_to_oid(decl: str) -> int:
+    up = (decl or "").upper()
+    for frag, oid in _TYPE_MAP:
+        if frag in up:
+            return oid
+    return 25 if up else 25  # typeless columns read as text
+
+
+def _user_objects(conn: sqlite3.Connection) -> List[Tuple[str, str, str]]:
+    """(type, name, tbl_name) for user tables/indexes/views — internal
+    corrosion/crsql bookkeeping stays hidden like the reference hides its
+    own (vtab/pg_class.rs filters to the user schema)."""
+    return conn.execute(
+        "SELECT type, name, tbl_name FROM sqlite_master WHERE type IN "
+        "('table', 'index', 'view') AND name NOT LIKE 'sqlite_%' AND "
+        "name NOT LIKE '__corro%' AND name NOT LIKE 'crsql_%' AND "
+        "name NOT LIKE '%__crsql_%' ORDER BY rowid"
+    ).fetchall()
+
+
+def build_catalog(conn: sqlite3.Connection) -> sqlite3.Connection:
+    """A fresh in-memory catalog database reflecting ``conn``'s schema."""
+    cat = sqlite3.connect(":memory:")
+    cat.executescript(_DDL)
+    cat.executemany(
+        "INSERT INTO pg_namespace (oid, nspname, nspowner) VALUES (?,?,10)",
+        [
+            (OID_PG_CATALOG, "pg_catalog"),
+            (OID_PUBLIC, "public"),
+            (13000, "information_schema"),
+        ],
+    )
+    cat.executemany(
+        "INSERT INTO pg_type (oid, typname, typnamespace, typowner, typlen,"
+        " typbyval, typtype, typcategory, typispreferred, typisdefined,"
+        " typdelim, typrelid, typelem, typarray, typbasetype, typtypmod,"
+        " typnotnull) VALUES (?,?,?,10,?,1,?,?,0,1,',',0,0,?,0,-1,0)",
+        [
+            (oid, name, OID_PG_CATALOG, typlen, typtype, typcat, typarray)
+            for oid, name, typlen, typtype, typcat, typarray in BUILTIN_TYPES
+        ],
+    )
+    cat.execute(
+        "INSERT INTO pg_database (oid, datname, datdba, encoding,"
+        " datistemplate, datallowconn, datconnlimit, datcollate, datctype)"
+        " VALUES (1, 'corrosion', 10, 6, 0, 1, -1, 'C', 'C')"
+    )
+    cat.execute(
+        "INSERT INTO pg_roles (oid, rolname, rolsuper, rolinherit,"
+        " rolcreaterole, rolcreatedb, rolcanlogin, rolreplication,"
+        " rolconnlimit) VALUES (10, 'corrosion', 1, 1, 1, 1, 1, 0, -1)"
+    )
+    cat.execute(
+        "INSERT INTO pg_am (oid, amname, amhandler, amtype) VALUES "
+        "(403, 'btree', 'bthandler', 'i')"
+    )
+
+    rel_oid = FIRST_REL_OID
+    for obj_type, name, tbl_name in _user_objects(conn):
+        relkind = {"table": "r", "index": "i", "view": "v"}[obj_type]
+        cols = (
+            conn.execute(f'PRAGMA table_info("{name}")').fetchall()
+            if obj_type != "index"
+            else []
+        )
+        cat.execute(
+            "INSERT INTO pg_class (oid, relname, relnamespace, reltype,"
+            " reloftype, relowner, relam, relfilenode, reltablespace,"
+            " relpages, reltuples, relallvisible, reltoastrelid,"
+            " relhasindex, relisshared, relpersistence, relkind, relnatts,"
+            " relchecks, relhasrules, relhastriggers, relhassubclass,"
+            " relrowsecurity, relforcerowsecurity, relispopulated,"
+            " relreplident, relispartition, relrewrite, relfrozenxid,"
+            " relminmxid) VALUES "
+            "(?,?,?,0,0,10,?,?,0,0,-1,0,0,0,0,'p',?,?,0,0,0,0,0,0,1,"
+            "'d',0,0,0,0)",
+            (
+                rel_oid,
+                name,
+                OID_PUBLIC,
+                403 if relkind == "i" else 0,
+                rel_oid,
+                relkind,
+                len(cols),
+            ),
+        )
+        for cid, colname, decl, notnull, default, pk in cols:
+            cat.execute(
+                "INSERT INTO pg_attribute (attrelid, attname, atttypid,"
+                " attstattarget, attlen, attnum, attndims, attcacheoff,"
+                " atttypmod, attbyval, attalign, attstorage,"
+                " attcompression, attnotnull, atthasdef, atthasmissing,"
+                " attidentity, attgenerated, attisdropped, attislocal,"
+                " attinhcount, attcollation) VALUES "
+                "(?,?,?,-1,-1,?,0,-1,-1,1,'i','p','',?,?,0,'','',0,1,0,0)",
+                (
+                    rel_oid,
+                    colname,
+                    sqlite_type_to_oid(decl),
+                    cid + 1,
+                    1 if (notnull or pk) else 0,
+                    1 if default is not None else 0,
+                ),
+            )
+        rel_oid += 1
+
+    _register_pg_functions(cat)
+    cat.commit()
+    return cat
+
+
+def _register_pg_functions(cat: sqlite3.Connection) -> None:
+    """The handful of pg_catalog functions introspection queries lean on."""
+    typnames = {
+        oid: name for oid, name, _len, _t, _c, _arr in BUILTIN_TYPES
+    }
+
+    def format_type(oid, typmod=None):
+        if oid is None:
+            return None
+        name = typnames.get(oid, "???")
+        aliases = {
+            "int8": "bigint",
+            "int4": "integer",
+            "int2": "smallint",
+            "float8": "double precision",
+            "float4": "real",
+            "bool": "boolean",
+            "varchar": "character varying",
+            "bpchar": "character",
+        }
+        return aliases.get(name, name)
+
+    cat.create_function("format_type", 1, format_type, deterministic=True)
+    cat.create_function("format_type", 2, format_type, deterministic=True)
+    cat.create_function(
+        "pg_table_is_visible", 1, lambda oid: 1, deterministic=True
+    )
+    cat.create_function(
+        "pg_get_userbyid", 1, lambda oid: "corrosion", deterministic=True
+    )
+    cat.create_function(
+        "pg_get_expr", 2, lambda expr, relid: expr, deterministic=True
+    )
+    cat.create_function(
+        "pg_get_expr", 3, lambda expr, relid, pretty: expr, deterministic=True
+    )
+    cat.create_function(
+        "current_schema", 0, lambda: "public", deterministic=True
+    )
+    cat.create_function(
+        "current_database", 0, lambda: "corrosion", deterministic=True
+    )
+    cat.create_function(
+        "pg_backend_pid", 0, lambda: 1, deterministic=True
+    )
+    cat.create_function(
+        "pg_encoding_to_char", 1, lambda enc: "UTF8", deterministic=True
+    )
+    cat.create_function(
+        "pg_total_relation_size", 1, lambda oid: 0, deterministic=True
+    )
+    cat.create_function(
+        "obj_description", 2, lambda oid, cls: None, deterministic=True
+    )
+    cat.create_function(
+        "col_description", 2, lambda oid, num: None, deterministic=True
+    )
+    cat.create_function(
+        "quote_ident", 1, lambda s: f'"{s}"', deterministic=True
+    )
+    cat.create_function("version", 0, lambda: "PostgreSQL 14.0 (corrosion-tpu)")

@@ -23,6 +23,15 @@ calls for.  Per round, in order:
    cycle driven by broadcast/mod.rs:162-374; auto-rejoin via
    Identity::renew, actor.rs:199-210).  Membership views are tracked per
    partition side (each side independently suspects the other).
+
+   *Abstraction ceiling*: the two per-side views (``status[2, N]``) model
+   cluster-consensus membership, not real SWIM's one-view-per-node.
+   They cannot represent view asymmetry WITHIN a side, multi-way
+   partitions, or flapping links — sufficient for BASELINE configs 1-5
+   (two-sided partitions at most) and for the round-count fidelity bar
+   (tests/test_sim_vs_harness.py runs with static membership), but a
+   per-node ``[N, N]`` view tensor is the upgrade path if a future
+   fidelity experiment exercises failure detection itself.
 3. *Broadcast*: every live node with budgeted chunks sends each held
    (changeset, chunk) payload to ``fanout`` targets it believes up —
    each payload is fanned out independently with its own target draws

@@ -43,6 +43,7 @@ class ApiConfig:
     # optional PostgreSQL wire-protocol listener (ref: config.rs pg addr,
     # wired in run_root.rs:67-74)
     pg_addr: Optional[str] = None
+    pg_password: Optional[str] = None  # cleartext auth on the PG listener
 
 
 @dataclass
@@ -89,6 +90,13 @@ class PerfConfig:
     flush_interval: float = 0.05
     sync_interval_min: float = 1.0
     sync_interval_max: float = 15.0  # ref: MAX_SYNC_BACKOFF (agent/mod.rs:33)
+    # Periodic maintenance (agent/node.py _maintenance_loops): overwritten-
+    # version compaction cadence (ref: clear_overwritten_versions_loop,
+    # run_root.rs:213 + util.rs:153-348) and WAL truncation cadence
+    # (ref: spawn_handle_db_cleanup 15-min checkpoint, run_root.rs:111-129).
+    # 0 disables the loop.
+    compact_interval: float = 60.0
+    wal_truncate_interval: float = 900.0
     # Harness-driven round pacing: when True the node does NOT free-run its
     # broadcast resend/fanout tasks or the anti-entropy loop — the dev
     # cluster harness drives them round-synchronously (DevCluster.step_round)

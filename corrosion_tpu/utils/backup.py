@@ -16,6 +16,7 @@ Equivalent of the ``corrosion backup`` / ``corrosion restore`` subcommands
 
 from __future__ import annotations
 
+import contextlib
 import os
 import shutil
 import sqlite3
@@ -46,40 +47,51 @@ def backup(db_path: str, out_path: str) -> None:
     if os.path.exists(out_path):
         raise BackupError(f"backup target already exists: {out_path}")
 
-    src = sqlite3.connect(db_path)
+    # any failure past this point must not leave a half-written snapshot
+    # behind looking like a valid backup (ADVICE r1: partial-target leak)
     try:
-        src.execute("VACUUM INTO ?", (out_path,))
-    finally:
-        src.close()
+        src = sqlite3.connect(db_path)
+        try:
+            src.execute("VACUUM INTO ?", (out_path,))
+        finally:
+            src.close()
+        conn = sqlite3.connect(out_path, isolation_level=None)
+        try:
+            _clean_snapshot(conn)
+        finally:
+            conn.close()
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.remove(out_path)
+        raise
 
-    conn = sqlite3.connect(out_path, isolation_level=None)
-    try:
-        row = conn.execute(
-            "DELETE FROM crsql_site_id WHERE ordinal = 0 RETURNING site_id"
-        ).fetchone()
-        if row is None:
-            raise BackupError("source database has no site id at ordinal 0")
-        site_id = bytes(row[0])
-        new_ordinal = conn.execute(
-            "INSERT INTO crsql_site_id (site_id) VALUES (?) RETURNING ordinal",
-            (site_id,),
-        ).fetchone()[0]
-        for table in _clock_tables(conn):
-            conn.execute(
-                f'UPDATE "{table}" SET site_id = ? WHERE site_id = 0',
-                (new_ordinal,),
-            )
-        # per-node state must not ride along into another node
-        conn.execute("DELETE FROM __corro_members")
-        for t in ("__corro_consul_services", "__corro_consul_checks"):
-            try:
-                conn.execute(f"DROP TABLE {t}")
-            except sqlite3.OperationalError:
-                pass  # never created on this node
-        conn.execute("PRAGMA journal_mode = WAL")  # restorable online
-        conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
-    finally:
-        conn.close()
+
+def _clean_snapshot(conn: sqlite3.Connection) -> None:
+    """Make the snapshot site-neutral + strip per-node state."""
+    row = conn.execute(
+        "DELETE FROM crsql_site_id WHERE ordinal = 0 RETURNING site_id"
+    ).fetchone()
+    if row is None:
+        raise BackupError("source database has no site id at ordinal 0")
+    site_id = bytes(row[0])
+    new_ordinal = conn.execute(
+        "INSERT INTO crsql_site_id (site_id) VALUES (?) RETURNING ordinal",
+        (site_id,),
+    ).fetchone()[0]
+    for table in _clock_tables(conn):
+        conn.execute(
+            f'UPDATE "{table}" SET site_id = ? WHERE site_id = 0',
+            (new_ordinal,),
+        )
+    # per-node state must not ride along into another node
+    conn.execute("DELETE FROM __corro_members")
+    for t in ("__corro_consul_services", "__corro_consul_checks"):
+        try:
+            conn.execute(f"DROP TABLE {t}")
+        except sqlite3.OperationalError:
+            pass  # never created on this node
+    conn.execute("PRAGMA journal_mode = WAL")  # restorable online
+    conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
 
 
 def restore_site_swap(backup_path: str, site_id: bytes) -> Optional[int]:

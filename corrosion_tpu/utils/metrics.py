@@ -67,25 +67,37 @@ def _san(name: str) -> str:
 
 
 class Counter:
+    """Mutations take a lock: read-modify-write on a float is not atomic
+    under free-running threads (pool worker threads observe metrics too),
+    and lost increments make series silently undercount (ADVICE r1)."""
+
     def __init__(self) -> None:
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0) -> None:
-        self.value += amount
+        with self._lock:
+            self.value += amount
 
 
 class Gauge:
     def __init__(self) -> None:
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, value: float) -> None:
-        self.value = value
+        # same lock as inc/dec: an unlocked store can be overwritten by a
+        # concurrent read-modify-write, silently discarding the set
+        with self._lock:
+            self.value = value
 
     def inc(self, amount: float = 1.0) -> None:
-        self.value += amount
+        with self._lock:
+            self.value += amount
 
     def dec(self, amount: float = 1.0) -> None:
-        self.value -= amount
+        with self._lock:
+            self.value -= amount
 
 
 class Histogram:
@@ -94,13 +106,15 @@ class Histogram:
         self.counts = [0] * len(self.buckets)
         self.total = 0
         self.sum = 0.0
+        self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
-        self.total += 1
-        self.sum += value
-        for i, bound in enumerate(self.buckets):
-            if value <= bound:
-                self.counts[i] += 1
+        with self._lock:
+            self.total += 1
+            self.sum += value
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    self.counts[i] += 1
 
     def time(self) -> "_Timer":
         return _Timer(self)

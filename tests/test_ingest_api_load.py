@@ -1,0 +1,111 @@
+"""Ingestion apply concurrency + HTTP load shedding.
+
+- ≤5 concurrent apply batches, overlapping for disjoint actors
+  (ref: handlers.rs:408-446 apply job pool)
+- /v1 routes are concurrency-limited with load shedding: overload is
+  rejected with 503 instead of queueing unboundedly
+  (ref: agent/util.rs:399-485)
+"""
+
+import asyncio
+import types
+import uuid
+
+import pytest
+from aiohttp import ClientSession, web
+
+from corrosion_tpu.agent.agent import Agent, AgentConfig
+from corrosion_tpu.agent.handlers import MAX_CONCURRENT_APPLIES, ChangeIngest
+from corrosion_tpu.api.http import Api
+from corrosion_tpu.types.actor import ActorId
+from corrosion_tpu.types.broadcast import ChangeSource, ChangesetFull, ChangeV1
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_apply_batches_overlap_bounded():
+    async def main():
+        agent = Agent(AgentConfig(db_path=":memory:", read_conns=1))
+        agent.open_sync()
+
+        in_flight = 0
+        seen_max = 0
+
+        async def slow_apply(changes):
+            nonlocal in_flight, seen_max
+            in_flight += 1
+            seen_max = max(seen_max, in_flight)
+            try:
+                await asyncio.sleep(0.02)
+                return types.SimpleNamespace(applied=[])
+            finally:
+                in_flight -= 1
+
+        agent.process_multiple_changes = slow_apply
+        ingest = ChangeIngest(
+            agent, apply_queue_len=1, flush_interval=0.001
+        )
+        ingest.start()
+        try:
+            for _ in range(20):
+                cv = ChangeV1(
+                    actor_id=ActorId(uuid.uuid4()),
+                    changeset=ChangesetFull(
+                        version=1, changes=(), seqs=(0, 0), last_seq=0, ts=0
+                    ),
+                )
+                await ingest.submit(cv, ChangeSource.SYNC)
+            for _ in range(400):
+                await asyncio.sleep(0.01)
+                if ingest.idle:
+                    break
+            assert ingest.idle
+            assert seen_max > 1, "apply batches never overlapped"
+            assert seen_max <= MAX_CONCURRENT_APPLIES
+        finally:
+            await ingest.stop()
+            agent.close()
+
+    run(main())
+
+
+def test_http_load_shedding_503():
+    async def main():
+        agent = Agent(AgentConfig(db_path=":memory:", read_conns=1))
+        agent.open_sync()
+        api = Api(agent, concurrency_limit=2)
+        gate = asyncio.Event()
+
+        async def gated_handler(request):
+            await gate.wait()
+            return web.json_response({"ok": True})
+
+        api.tx_handler = gated_handler  # must patch before build_app
+        port = await api.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with ClientSession() as http:
+                blocked = [
+                    asyncio.create_task(
+                        http.post(f"{base}/v1/transactions", json=[])
+                    )
+                    for _ in range(2)
+                ]
+                await asyncio.sleep(0.2)  # both now hold the limit
+                r = await http.post(f"{base}/v1/transactions", json=[])
+                assert r.status == 503, await r.text()
+                assert "overloaded" in (await r.json())["error"]
+                gate.set()
+                for t in blocked:
+                    r = await t
+                    assert r.status == 200
+                # limit released: new requests pass again
+                r = await http.post(f"{base}/v1/transactions", json=[])
+                assert r.status == 200
+        finally:
+            await api.stop()
+            agent.close()
+
+    run(main())

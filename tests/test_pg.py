@@ -420,10 +420,165 @@ def test_set_show_and_pg_catalog_shims():
         assert not errors and tags == ["SHOW"] and rows == [["on"]]
 
         _, rows, tags, errors, _ = await pg.query(
-            "SELECT oid, typname FROM pg_catalog.pg_type"
+            "SELECT oid, typname FROM pg_catalog.pg_type WHERE typname = "
+            "'text'"
         )
-        assert not errors and rows == [] and tags == ["SELECT 0"]
+        assert not errors and rows == [["25", "text"]]
 
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_catalog_introspection():
+    """psql/psycopg-style introspection sees REAL tables and columns
+    (ref: corro-pg/src/vtab/ pg_class/pg_namespace/pg_attribute)."""
+
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+
+        # \dt-style: user tables under 'public'
+        cols, rows, _, errors, _ = await pg.query(
+            "SELECT c.relname, n.nspname FROM pg_catalog.pg_class c "
+            "JOIN pg_catalog.pg_namespace n ON n.oid = c.relnamespace "
+            "WHERE c.relkind = 'r' AND n.nspname = 'public' "
+            "ORDER BY c.relname"
+        )
+        assert not errors, errors
+        assert ["tests", "public"] in rows
+        # internal bookkeeping tables stay hidden
+        assert not any(r[0].startswith("__corro") for r in rows)
+
+        # \d tests-style: columns via 'tests'::regclass
+        _, rows, _, errors, _ = await pg.query(
+            "SELECT a.attname, a.attnotnull, "
+            "pg_catalog.format_type(a.atttypid) FROM "
+            "pg_catalog.pg_attribute a WHERE a.attrelid = "
+            "'tests'::regclass AND a.attnum > 0 ORDER BY a.attnum"
+        )
+        assert not errors, errors
+        assert rows == [
+            ["id", "1", "bigint"],
+            ["text", "1", "text"],
+        ]
+
+        # information_schema flavor (ORMs)
+        _, rows, _, errors, _ = await pg.query(
+            "SELECT column_name, data_type, is_nullable FROM "
+            "information_schema.columns WHERE table_name = 'tests' "
+            "ORDER BY ordinal_position"
+        )
+        assert not errors, errors
+        assert rows == [
+            ["id", "bigint", "NO"],
+            ["text", "text", "NO"],
+        ]
+
+        # pg_database row exists
+        _, rows, _, errors, _ = await pg.query(
+            "SELECT datname FROM pg_catalog.pg_database"
+        )
+        assert not errors and rows == [["corrosion"]]
+
+        # rewrites never touch string data: a literal that LOOKS like a
+        # qualifier or a regclass cast comes back verbatim
+        _, rows, _, errors, _ = await pg.query(
+            "SELECT 'pg_catalog.pg_type', '''x''::regclass' FROM "
+            "pg_catalog.pg_database"
+        )
+        assert not errors, errors
+        assert rows == [["pg_catalog.pg_type", "'x'::regclass"]]
+
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_password_auth():
+    async def main():
+        agent = Agent(AgentConfig(db_path=":memory:", read_conns=1)).open_sync()
+        server = PgServer(agent, password="sekrit")
+        port = await server.start()
+
+        async def attempt(password):
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            body = struct.pack("!I", 196608) + b"user\x00u\x00\x00"
+            writer.write(struct.pack("!I", len(body) + 4) + body)
+            await writer.drain()
+            kind = await reader.readexactly(1)
+            (length,) = struct.unpack("!I", await reader.readexactly(4))
+            payload = await reader.readexactly(length - 4)
+            assert kind == b"R" and struct.unpack("!I", payload)[0] == 3
+            pw = password.encode() + b"\x00"
+            writer.write(b"p" + struct.pack("!I", len(pw) + 4) + pw)
+            await writer.drain()
+            kind = await reader.readexactly(1)
+            (length,) = struct.unpack("!I", await reader.readexactly(4))
+            payload = await reader.readexactly(length - 4)
+            writer.close()
+            return kind, payload
+
+        kind, payload = await attempt("wrong")
+        assert kind == b"E" and b"28P01" in payload
+
+        kind, payload = await attempt("sekrit")
+        assert kind == b"R" and struct.unpack("!I", payload)[0] == 0
+
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_comment_aware_splitting_and_classification():
+    from corrosion_tpu.pg import classify, strip_comments
+
+    # ';' inside comments must not split (ADVICE r2 finding)
+    stmts = split_statements(
+        "SELECT 1; -- trailing; tricky\n"
+        "SELECT 2 /* mid; comment */; /* just; a; comment */ SELECT 3"
+    )
+    assert [strip_comments(s).strip() for s in stmts] == [
+        "SELECT 1",
+        "SELECT 2",
+        "SELECT 3",
+    ]
+    # comment-only fragments vanish
+    assert split_statements("-- nothing\n/* here */") == []
+    # classification ignores leading comments
+    assert classify("-- hint\nSELECT 1") == "read"
+    assert classify("/* x */ INSERT INTO t VALUES (1)") == "write"
+    # nested block comments (PG nests; SQLite doesn't — must be stripped)
+    assert strip_comments("SELECT /* a /* b */ c */ 1").split() == [
+        "SELECT",
+        "1",
+    ]
+    # comment text is never rewritten as code, quotes keep comments verbatim
+    assert translate_sql("SELECT '$1 -- not a comment'") == (
+        "SELECT '$1 -- not a comment'"
+    )
+
+
+def test_comments_through_the_server():
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+        _, _, tags, errors, _ = await pg.query(
+            "-- leading comment; with semicolon\n"
+            "INSERT INTO tests (id, text) VALUES (1, 'a; -- b');"
+        )
+        assert not errors, errors
+        assert tags == ["INSERT 0 1"]
+        _, rows, _, errors, _ = await pg.query(
+            "/* block; comment */ SELECT text FROM tests"
+        )
+        assert not errors, errors
+        assert rows == [["a; -- b"]]
         await pg.close()
         await server.stop()
         agent.close()

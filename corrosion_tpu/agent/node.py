@@ -73,6 +73,7 @@ class Node:
         self.subs: Optional[SubsManager] = None
         self.admin = None  # AdminServer when config.admin.uds_path is set
         self.pg = None  # PgServer when config.api.pg_addr is set
+        self.otlp = None  # OtlpExporter when telemetry.otlp_* is set
         self._prom_runner = None  # prometheus exporter AppRunner
         self.prometheus_port: Optional[int] = None
         self._tasks: List[asyncio.Task] = []
@@ -122,7 +123,30 @@ class Node:
                 insecure=tls.insecure,
             )
         udp_sock, tcp_sock = self._gossip_socks or (None, None)
-        self.transport = Transport(
+        transport_cls = Transport
+        t_impl = self.config.gossip.transport_impl
+        if t_impl not in ("native", "python"):
+            raise ValueError(
+                f"gossip.transport_impl must be 'native' or 'python', "
+                f"got {t_impl!r}"
+            )
+        if t_impl == "native" and ssl_server is None and ssl_client is None:
+            # TLS stays on the python path (the native core is the
+            # plaintext gossip mode, like the reference's quinn-plaintext)
+            try:
+                from ..transport.native import (
+                    NativeTransport,
+                    load as load_transport_lib,
+                )
+
+                # the first call may invoke g++ — keep it off the loop
+                await asyncio.to_thread(load_transport_lib)
+                transport_cls = NativeTransport
+            except (RuntimeError, OSError) as e:
+                logger.warning(
+                    "native transport unavailable (%s); using python", e
+                )
+        self.transport = transport_cls(
             host=gossip_host,
             port=gossip_port,
             on_datagram=self._on_datagram,
@@ -134,6 +158,7 @@ class Node:
             tcp_sock=tcp_sock,
         )
         addr = await self.transport.start()
+        logger.debug("transport: %s", type(self.transport).__name__)
         self.transport.on_rtt = lambda a, rtt: self._on_rtt(a, rtt)
 
         identity = Actor(
@@ -210,6 +235,18 @@ class Node:
             )
             await self.pg.start(pg_host, pg_port)
 
+        if (
+            self.config.telemetry.otlp_endpoint
+            or self.config.telemetry.otlp_file
+        ):
+            from ..utils.otlp import OtlpExporter
+
+            self.otlp = OtlpExporter(
+                endpoint=self.config.telemetry.otlp_endpoint,
+                file_path=self.config.telemetry.otlp_file,
+                extra_attrs={"corrosion.actor": self.agent.actor_id.as_simple()},
+            ).start()
+
         if self.config.telemetry.prometheus_addr:
             from ..utils.metrics import render_prometheus
             from aiohttp import web as aioweb
@@ -276,6 +313,9 @@ class Node:
         if self.pg is not None:
             await self.pg.stop()
             self.pg = None
+        if self.otlp is not None:
+            await self.otlp.stop()
+            self.otlp = None
         if self._prom_runner is not None:
             await self._prom_runner.cleanup()
             self._prom_runner = None

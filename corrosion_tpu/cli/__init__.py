@@ -59,10 +59,25 @@ def api_base(config: Config) -> str:
 
 
 async def cmd_agent(args) -> int:
+    import os
+    import socket as socketmod
+
     from ..agent.node import Node
 
     config = load_config(args)
-    node = await Node(config).start()
+    gossip_socks = None
+    inherited = os.environ.get("CORRO_GOSSIP_FDS")
+    if inherited:
+        # pre-bound UDP,TCP fds handed down by a spawning harness
+        # (SubprocessCluster) — ports were assigned before any child
+        # started, and inheriting the bound sockets closes the
+        # probe-then-bind race across processes
+        udp_fd, tcp_fd = (int(x) for x in inherited.split(","))
+        gossip_socks = (
+            socketmod.socket(fileno=udp_fd),
+            socketmod.socket(fileno=tcp_fd),
+        )
+    node = await Node(config, gossip_socks=gossip_socks).start()
     gossip_host, gossip_port = node.gossip_addr
     print(
         f"agent running: api=127.0.0.1:{node.api.port} "

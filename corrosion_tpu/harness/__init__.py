@@ -224,7 +224,7 @@ class DevCluster:
 
     async def settle(
         self,
-        quiet_checks: int = 3,
+        quiet_checks: int = 4,
         interval: float = 0.02,
         timeout: float = 30.0,
     ) -> None:
@@ -295,11 +295,18 @@ class SubprocessCluster:
         self.procs: Dict[str, subprocess.Popen] = {}
         self.api_ports: Dict[str, int] = {}
         self.admin_socks: Dict[str, str] = {}
+        self._socks: Dict[str, tuple] = {}  # bound gossip pairs pre-spawn
 
     def generate_configs(self) -> Dict[str, str]:
         """Write per-node state dirs + TOML configs; returns config paths
-        (ref: generate_config, main.rs:117-155)."""
-        ports = {n: free_port() for n in self.topology.nodes}
+        (ref: generate_config, main.rs:117-155).  Gossip ports are bound
+        HERE as socket pairs and inherited by the child processes
+        (CORRO_GOSSIP_FDS), so pre-assigned ports can't be stolen between
+        config generation and child startup."""
+        from ..transport.net import bind_port_pair
+
+        self._socks = {n: bind_port_pair() for n in self.topology.nodes}
+        ports = {n: s[0] for n, s in self._socks.items()}
         configs: Dict[str, str] = {}
         for name in self.topology.nodes:
             node_dir = os.path.join(self.state_dir, name)
@@ -357,6 +364,11 @@ uds_path = "{admin_sock}"
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         for name in order:
             log_path = os.path.join(self.state_dir, name, "agent.log")
+            _, udp, tcp = self._socks[name]
+            child_env = {
+                **env,
+                "CORRO_GOSSIP_FDS": f"{udp.fileno()},{tcp.fileno()}",
+            }
             with open(log_path, "wb") as log:
                 self.procs[name] = subprocess.Popen(
                     [
@@ -367,10 +379,14 @@ uds_path = "{admin_sock}"
                         configs[name],
                         "agent",
                     ],
-                    env=env,
+                    env=child_env,
                     stdout=log,
                     stderr=subprocess.STDOUT,
+                    pass_fds=(udp.fileno(), tcp.fileno()),
                 )
+            # the child holds its inherited copies; release ours
+            udp.close()
+            tcp.close()
         deadline = time.monotonic() + startup_timeout
         for name in order:
             while not os.path.exists(self.admin_socks[name]):
@@ -394,6 +410,11 @@ uds_path = "{admin_sock}"
             return "<no log>"
 
     def stop(self) -> None:
+        for _, udp, tcp in self._socks.values():
+            for s in (udp, tcp):
+                with contextlib.suppress(OSError):
+                    s.close()  # pairs for children that never spawned
+        self._socks.clear()
         for proc in self.procs.values():
             proc.terminate()
         for proc in self.procs.values():

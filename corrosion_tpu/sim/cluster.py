@@ -196,6 +196,32 @@ def make_step(p: SimParams):
             return u
         return t + (t >= nvec)  # skip self
 
+    def bcast_target_shared(r, slot: int, a: int):
+        """[N] fanout target per node for (round, slot, attempt) — the
+        shared-draw scale approximation (fanout_per_change=False): one
+        target set per node per round, reused for every payload."""
+        suffix = () if a == 0 else (a,)
+        if p.topology == ER:
+            i = jx_below(
+                p.er_degree, p.seed, TAG_BCAST, r, narange, slot, *suffix
+            )
+            t = jx_below(N - 1, p.seed, TAG_TOPO, narange, i)
+        elif p.topology == POWERLAW:
+            draws = [
+                jx_below(
+                    N - 1, p.seed, TAG_BCAST, r, narange,
+                    slot * p.powerlaw_gamma + g, *suffix,
+                )
+                for g in range(p.powerlaw_gamma)
+            ]
+            t = draws[0]
+            for d in draws[1:]:
+                t = jnp.minimum(t, d)
+        else:
+            assert p.topology == COMPLETE
+            t = jx_below(N - 1, p.seed, TAG_BCAST, r, narange, slot, *suffix)
+        return t + (t >= narange)  # skip self
+
     def step(state: SimState) -> SimState:
         cov, budget, status, since, r = state
         alive = alive_at(r)
@@ -294,21 +320,35 @@ def make_step(p: SimParams):
             bit = jnp.uint8(1 << s)
             plane = jnp.zeros((N, K), dtype=bool)
             hold = jnp.logical_and(pend, (cov & bit).astype(bool))
-            chosen = []
-            for j in range(p.fanout):
-                slot = j * S + s
-                t, found = draw_excluding(
-                    down2,
-                    view[:, None],
-                    lambda a, slot=slot, ch=tuple(chosen): bcast_target(
-                        r, slot, a, ch
-                    ),
-                )
-                ok = jnp.logical_and(
-                    jnp.logical_and(found, pvec[:, None] == pvec[t]), alive[t]
-                )
-                plane = plane.at[t, kk].max(hold & ok)
-                chosen.append(t)
+            if p.fanout_per_change:
+                chosen = []
+                for j in range(p.fanout):
+                    slot = j * S + s
+                    t, found = draw_excluding(
+                        down2,
+                        view[:, None],
+                        lambda a, slot=slot, ch=tuple(chosen): bcast_target(
+                            r, slot, a, ch
+                        ),
+                    )
+                    ok = jnp.logical_and(
+                        jnp.logical_and(found, pvec[:, None] == pvec[t]),
+                        alive[t],
+                    )
+                    plane = plane.at[t, kk].max(hold & ok)
+                    chosen.append(t)
+            else:
+                for j in range(p.fanout):
+                    slot = j * S + s
+                    t, found = draw_excluding(
+                        down2,
+                        view,
+                        lambda a, slot=slot: bcast_target_shared(r, slot, a),
+                    )
+                    ok = jnp.logical_and(
+                        jnp.logical_and(found, pvec == pvec[t]), alive[t]
+                    )
+                    plane = plane.at[t].max(hold & ok[:, None])
             delivered = delivered | jnp.where(plane, bit, jnp.uint8(0))
 
         # 4. receive: accumulate chunks, refresh budgets on new coverage

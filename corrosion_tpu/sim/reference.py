@@ -90,6 +90,28 @@ def _bcast_target(
     return t + 1 if t >= n else t
 
 
+def _bcast_target_shared(p: SimParams, r: int, n: int, slot: int, a: int) -> int:
+    """Shared-draw variant (fanout_per_change=False): one target per
+    (round, node, slot, attempt), reused for all payloads — mirrors
+    sim.cluster.bcast_target_shared."""
+    suffix = () if a == 0 else (a,)
+    if p.topology == ER:
+        i = py_below(p.er_degree, p.seed, TAG_BCAST, r, n, slot, *suffix)
+        t = py_below(p.n_nodes - 1, p.seed, TAG_TOPO, n, i)
+    elif p.topology == POWERLAW:
+        t = min(
+            py_below(
+                p.n_nodes - 1, p.seed, TAG_BCAST, r, n,
+                slot * p.powerlaw_gamma + g, *suffix,
+            )
+            for g in range(p.powerlaw_gamma)
+        )
+    else:
+        assert p.topology == COMPLETE
+        t = py_below(p.n_nodes - 1, p.seed, TAG_BCAST, r, n, slot, *suffix)
+    return t + 1 if t >= n else t
+
+
 def _probe_target(p: SimParams, r: int, n: int, a: int) -> int:
     suffix = () if a == 0 else (a,)
     t = py_below(p.n_nodes - 1, p.seed, TAG_PROBE, r, n, *suffix)
@@ -215,27 +237,49 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
         for n in range(N):
             if not alive[n]:
                 continue
-            for k in range(K):
-                if not pend[n][k]:
-                    continue
-                for s in range(S):
-                    bit = 1 << s
-                    if not snap[n][k] & bit:
+            if p.fanout_per_change:
+                for k in range(K):
+                    if not pend[n][k]:
                         continue
-                    chosen: List[int] = []
-                    for j in range(p.fanout):
+                    for s in range(S):
+                        bit = 1 << s
+                        if not snap[n][k] & bit:
+                            continue
+                        chosen: List[int] = []
+                        for j in range(p.fanout):
+                            slot = j * S + s
+                            t, found = draw_excluding(
+                                n,
+                                lambda a, slot=slot, ch=chosen: _bcast_target(
+                                    p, r, n, slot, k, a, ch
+                                ),
+                                part[n],
+                            )
+                            chosen.append(t)
+                            if (
+                                not found
+                                or pvec[n] != pvec[t]
+                                or not alive[t]
+                            ):
+                                continue
+                            delivered[t][k] |= bit
+            else:
+                for j in range(p.fanout):
+                    for s in range(S):
                         slot = j * S + s
                         t, found = draw_excluding(
                             n,
-                            lambda a, slot=slot, ch=chosen: _bcast_target(
-                                p, r, n, slot, k, a, ch
+                            lambda a, slot=slot: _bcast_target_shared(
+                                p, r, n, slot, a
                             ),
                             part[n],
                         )
-                        chosen.append(t)
                         if not found or pvec[n] != pvec[t] or not alive[t]:
                             continue
-                        delivered[t][k] |= bit
+                        bit = 1 << s
+                        for k in range(K):
+                            if pend[n][k] and snap[n][k] & bit:
+                                delivered[t][k] |= bit
 
         # 4. receive
         for n in range(N):

@@ -1,0 +1,328 @@
+"""Native transport: ctypes binding + asyncio integration.
+
+The C++ core (transport.cpp) owns every socket on its own epoll thread;
+this module adapts it to the exact interface of the Python
+:class:`corrosion_tpu.transport.net.Transport` — ``start``/``stop``,
+``send_datagram``/``send_uni``/``open_bi``, the ``on_datagram``/
+``on_uni_frame``/``on_bi_stream`` callbacks and the ``on_rtt`` feed — so
+``Node`` swaps implementations via ``gossip.transport_impl`` with no
+protocol-layer changes (the same pattern as the native SWIM core,
+swim/native/__init__.py).
+
+Event flow: the C loop signals an eventfd; asyncio watches it with
+``loop.add_reader`` and drains the C event queue on wakeup, copying each
+payload once into Python bytes.  TLS stays on the Python implementation
+(config validation in agent/node.py): the native path is the plaintext
+gossip mode, like the reference's ``quinn-plaintext``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import ctypes
+import os
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+
+from ...utils.nativebuild import build_if_stale
+
+Addr = Tuple[str, int]
+
+EV_DGRAM = 1
+EV_UNI_FRAME = 2
+EV_BI_ACCEPT = 3
+EV_BI_FRAME = 4
+EV_BI_CLOSED = 5
+EV_BI_CONNECTED = 6
+EV_RTT = 7
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "transport.cpp")
+_OUT = os.path.join(_HERE, "libcorrotransport.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def load() -> ctypes.CDLL:
+    """Build (if stale) and load the native transport library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", "{tmp}",
+    ]
+    path = build_if_stale(_SRC, _OUT, cmd)
+    lib = ctypes.CDLL(path)
+    lib.corro_tp_create.restype = ctypes.c_void_p
+    lib.corro_tp_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.corro_tp_port.restype = ctypes.c_int
+    lib.corro_tp_port.argtypes = [ctypes.c_void_p]
+    lib.corro_tp_event_fd.restype = ctypes.c_int
+    lib.corro_tp_event_fd.argtypes = [ctypes.c_void_p]
+    lib.corro_tp_next_conn_id.restype = ctypes.c_int64
+    lib.corro_tp_next_conn_id.argtypes = [ctypes.c_void_p]
+    for name in ("corro_tp_send_datagram", "corro_tp_send_uni"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+    lib.corro_tp_bi_open.restype = None
+    lib.corro_tp_bi_open.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.corro_tp_bi_send.restype = None
+    lib.corro_tp_bi_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.corro_tp_bi_close.restype = None
+    lib.corro_tp_bi_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.corro_tp_next_event.restype = ctypes.c_int
+    lib.corro_tp_next_event.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.corro_tp_free.restype = None
+    lib.corro_tp_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.corro_tp_stop.restype = None
+    lib.corro_tp_stop.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class NativeFramedStream:
+    """FramedStream-compatible facade over one native bi connection."""
+
+    def __init__(self, transport: "NativeTransport", conn_id: int) -> None:
+        self._tp = transport
+        self.conn_id = conn_id
+        self.queue: "asyncio.Queue[Optional[bytes]]" = asyncio.Queue()
+        self.closed = False
+
+    async def send(self, payload: bytes) -> None:
+        if self.closed:
+            raise ConnectionError("stream is closed")
+        self._tp._lib.corro_tp_bi_send(
+            self._tp._handle, self.conn_id, payload, len(payload)
+        )
+
+    async def recv(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        if self.closed and self.queue.empty():
+            return None
+        if timeout is None:
+            got = await self.queue.get()
+        else:
+            got = await asyncio.wait_for(self.queue.get(), timeout)
+        if got is None:
+            self.closed = True
+        return got
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            if self._tp._handle is not None:
+                self._tp._lib.corro_tp_bi_close(self._tp._handle, self.conn_id)
+            self._tp._streams.pop(self.conn_id, None)
+        with contextlib.suppress(asyncio.QueueFull):
+            self.queue.put_nowait(None)
+
+    async def wait_closed(self) -> None:
+        return None
+
+
+class NativeTransport:
+    """Drop-in Transport implementation backed by the C++ core."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        on_datagram: Optional[Callable[[Addr, bytes], None]] = None,
+        on_uni_frame: Optional[Callable[[Addr, bytes], Awaitable[None]]] = None,
+        on_bi_stream: Optional[
+            Callable[[Addr, NativeFramedStream], Awaitable[None]]
+        ] = None,
+        ssl_server=None,
+        ssl_client=None,
+        udp_sock=None,
+        tcp_sock=None,
+    ) -> None:
+        if ssl_server is not None or ssl_client is not None:
+            raise ValueError(
+                "native transport is plaintext-only; use the python "
+                "implementation for TLS/mTLS gossip"
+            )
+        self.host = host
+        self.port = port
+        self.on_datagram = on_datagram or (lambda a, d: None)
+        self.on_uni_frame = on_uni_frame
+        self.on_bi_stream = on_bi_stream
+        self.on_rtt: Optional[Callable[[Addr, float], None]] = None
+        self._udp_sock = udp_sock
+        self._tcp_sock = tcp_sock
+        self._lib = load()
+        self._handle: Optional[int] = None
+        self._event_fd: Optional[int] = None
+        self._streams: Dict[int, NativeFramedStream] = {}
+        self._connect_waiters: Dict[int, asyncio.Future] = {}
+        self._tasks: set = set()
+
+    async def start(self) -> Addr:
+        if (
+            self._udp_sock is None or self._tcp_sock is None
+        ) and self.port == 0:
+            # bind the UDP+TCP pair here with the retry-on-collision logic
+            # (an ephemeral UDP port's TCP twin may already be taken — a
+            # single blind attempt in the C core flakes under load)
+            from ..net import bind_port_pair
+
+            self.port, self._udp_sock, self._tcp_sock = bind_port_pair(
+                self.host
+            )
+        if self._udp_sock is not None and self._tcp_sock is not None:
+            # hand off ownership of the pre-bound pair to the C loop
+            udp_fd = self._udp_sock.detach()
+            tcp_fd = self._tcp_sock.detach()
+            self._udp_sock = self._tcp_sock = None
+        else:
+            udp_fd = tcp_fd = -1
+        self._handle = self._lib.corro_tp_create(
+            self.host.encode(), self.port, udp_fd, tcp_fd
+        )
+        if not self._handle:
+            raise OSError("native transport failed to bind")
+        self.port = self._lib.corro_tp_port(self._handle)
+        self._event_fd = self._lib.corro_tp_event_fd(self._handle)
+        asyncio.get_running_loop().add_reader(self._event_fd, self._drain)
+        return (self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._handle is None:
+            return
+        asyncio.get_running_loop().remove_reader(self._event_fd)
+        for stream in list(self._streams.values()):
+            stream.closed = True  # no bi_close into a dying handle
+            with contextlib.suppress(asyncio.QueueFull):
+                stream.queue.put_nowait(None)
+        self._streams.clear()
+        for fut in self._connect_waiters.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("transport stopped"))
+        self._connect_waiters.clear()
+        handle, self._handle = self._handle, None
+        self._lib.corro_tp_stop(handle)
+        for t in self._tasks:
+            t.cancel()
+
+    # -- outgoing ---------------------------------------------------------
+
+    def send_datagram(self, addr: Addr, payload: bytes) -> None:
+        if self._handle is not None:
+            self._lib.corro_tp_send_datagram(
+                self._handle, addr[0].encode(), addr[1], payload, len(payload)
+            )
+
+    async def send_uni(self, addr: Addr, payload: bytes) -> None:
+        if self._handle is not None:
+            self._lib.corro_tp_send_uni(
+                self._handle, addr[0].encode(), addr[1], payload, len(payload)
+            )
+
+    async def open_bi(self, addr: Addr) -> NativeFramedStream:
+        assert self._handle is not None
+        conn_id = self._lib.corro_tp_next_conn_id(self._handle)
+        stream = NativeFramedStream(self, conn_id)
+        self._streams[conn_id] = stream
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._connect_waiters[conn_id] = fut
+        self._lib.corro_tp_bi_open(
+            self._handle, conn_id, addr[0].encode(), addr[1]
+        )
+        try:
+            await asyncio.wait_for(fut, 5.0)
+        except (asyncio.TimeoutError, ConnectionError):
+            stream.close()
+            raise ConnectionError(f"bi connect to {addr} failed")
+        finally:
+            self._connect_waiters.pop(conn_id, None)
+        return stream
+
+    # -- event pump -------------------------------------------------------
+
+    def _drain(self) -> None:
+        with contextlib.suppress(BlockingIOError, OSError):
+            os.read(self._event_fd, 8)  # reset the eventfd counter
+        if self._handle is None:
+            return
+        etype = ctypes.c_int()
+        conn_id = ctypes.c_int64()
+        ip_buf = ctypes.create_string_buffer(64)
+        port = ctypes.c_int()
+        rtt = ctypes.c_double()
+        data_ptr = ctypes.POINTER(ctypes.c_uint8)()
+        data_len = ctypes.c_int()
+        while self._handle is not None and self._lib.corro_tp_next_event(
+            self._handle,
+            ctypes.byref(etype),
+            ctypes.byref(conn_id),
+            ip_buf,
+            64,
+            ctypes.byref(port),
+            ctypes.byref(rtt),
+            ctypes.byref(data_ptr),
+            ctypes.byref(data_len),
+        ):
+            addr = (ip_buf.value.decode(), port.value)
+            payload = b""
+            if data_ptr:
+                payload = ctypes.string_at(data_ptr, data_len.value)
+                self._lib.corro_tp_free(data_ptr)
+            self._dispatch(etype.value, conn_id.value, addr, rtt.value, payload)
+
+    def _spawn(self, coro) -> None:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    def _dispatch(
+        self, etype: int, conn_id: int, addr: Addr, rtt_ms: float, data: bytes
+    ) -> None:
+        if etype == EV_DGRAM:
+            self.on_datagram(addr, data)
+        elif etype == EV_UNI_FRAME:
+            if self.on_uni_frame is not None:
+                self._spawn(self.on_uni_frame(addr, data))
+        elif etype == EV_BI_ACCEPT:
+            stream = NativeFramedStream(self, conn_id)
+            self._streams[conn_id] = stream
+            if self.on_bi_stream is not None:
+                self._spawn(self.on_bi_stream(addr, stream))
+        elif etype == EV_BI_FRAME:
+            stream = self._streams.get(conn_id)
+            if stream is not None:
+                stream.queue.put_nowait(data)
+        elif etype == EV_BI_CLOSED:
+            stream = self._streams.pop(conn_id, None)
+            if stream is not None:
+                stream.closed = True
+                stream.queue.put_nowait(None)
+            waiter = self._connect_waiters.get(conn_id)
+            if waiter is not None and not waiter.done():
+                waiter.set_exception(ConnectionError("connect failed"))
+        elif etype == EV_BI_CONNECTED:
+            waiter = self._connect_waiters.get(conn_id)
+            if waiter is not None and not waiter.done():
+                waiter.set_result(True)
+        elif etype == EV_RTT:
+            if self.on_rtt is not None:
+                self.on_rtt(addr, rtt_ms)

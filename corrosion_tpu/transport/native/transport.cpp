@@ -1,0 +1,644 @@
+// Native transport core: UDP datagrams + framed TCP streams over epoll.
+//
+// TPU-era equivalent of the reference's quinn-based transport layer
+// (crates/corro-agent/src/transport.rs): three channel classes on one
+// port — unreliable datagrams for SWIM probes, uni-directional framed
+// streams for broadcasts, bi-directional framed streams for sync
+// sessions — with cached outgoing connections and connect-time RTT
+// sampling fed back to the member rings (transport.rs:55-76, 220).
+// QUIC itself is not reimplemented; the channel semantics the protocol
+// machines rely on are provided over UDP + TCP (the reference's
+// gossip.plaintext mode), and TLS stays on the Python path.
+//
+// Threading model: one event-loop thread owns every socket.  Callers
+// enqueue commands (send datagram / send uni frame / open-send-close bi)
+// into a mutex-protected queue and wake the loop via eventfd; the loop
+// pushes events (received datagrams/frames, accepts, closes, RTT
+// samples) into a second queue and signals a second eventfd that the
+// Python side watches with asyncio's add_reader.  No Python locks are
+// ever held inside the loop; payloads are copied at both boundaries.
+//
+// Wire format: 1 magic byte per connection ('U' uni / 'B' bi), then
+// u32-BE length-delimited frames (corrosion_tpu/wire.py framing).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMaxFrame = 32u * 1024 * 1024;  // defensive length cap
+constexpr size_t kReadChunk = 65536;
+
+enum EventType {
+  EV_DGRAM = 1,
+  EV_UNI_FRAME = 2,
+  EV_BI_ACCEPT = 3,
+  EV_BI_FRAME = 4,
+  EV_BI_CLOSED = 5,
+  EV_BI_CONNECTED = 6,
+  EV_RTT = 7,
+};
+
+enum CmdType {
+  CMD_DGRAM = 1,
+  CMD_UNI = 2,
+  CMD_BI_OPEN = 3,
+  CMD_BI_SEND = 4,
+  CMD_BI_CLOSE = 5,
+  CMD_STOP = 6,
+};
+
+struct Event {
+  int type;
+  int64_t conn_id;
+  std::string ip;
+  int port;
+  double rtt_ms;
+  std::vector<uint8_t> data;
+};
+
+struct Cmd {
+  int type;
+  int64_t conn_id;
+  std::string ip;
+  int port;
+  std::vector<uint8_t> data;
+};
+
+struct Conn {
+  int fd = -1;
+  int64_t id = 0;
+  bool outgoing = false;
+  char mode = 0;  // 0 = inbound awaiting magic; 'U' or 'B'
+  bool connecting = false;
+  std::chrono::steady_clock::time_point t0;
+  std::string ip;
+  int port = 0;
+  std::vector<uint8_t> rbuf;
+  std::deque<uint8_t> wbuf;
+};
+
+uint64_t now_ms_marker() { return 0; }
+
+int set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  return fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+struct Transport {
+  int udp_fd = -1;
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1;   // command wakeup
+  int event_fd = -1;  // event notification toward Python
+  int port = 0;
+  std::string host;
+
+  std::thread loop_thread;
+  std::atomic<bool> running{false};
+
+  std::mutex cmd_mu;
+  std::deque<Cmd> cmds;
+  std::mutex ev_mu;
+  std::deque<Event> events;
+
+  std::atomic<int64_t> next_id{1};
+  std::map<int64_t, Conn *> conns;            // by id
+  std::map<int, int64_t> by_fd;               // fd -> id
+  std::map<std::pair<std::string, int>, int64_t> uni_cache;
+
+  ~Transport() {
+    for (auto &kv : conns) {
+      if (kv.second->fd >= 0) close(kv.second->fd);
+      delete kv.second;
+    }
+    if (udp_fd >= 0) close(udp_fd);
+    if (listen_fd >= 0) close(listen_fd);
+    if (epoll_fd >= 0) close(epoll_fd);
+    if (wake_fd >= 0) close(wake_fd);
+    if (event_fd >= 0) close(event_fd);
+  }
+
+  void push_event(Event &&ev) {
+    {
+      std::lock_guard<std::mutex> g(ev_mu);
+      events.push_back(std::move(ev));
+    }
+    uint64_t one = 1;
+    ssize_t n = write(event_fd, &one, sizeof(one));
+    (void)n;
+  }
+
+  void enqueue_cmd(Cmd &&cmd) {
+    {
+      std::lock_guard<std::mutex> g(cmd_mu);
+      cmds.push_back(std::move(cmd));
+    }
+    uint64_t one = 1;
+    ssize_t n = write(wake_fd, &one, sizeof(one));
+    (void)n;
+  }
+
+  void arm(Conn *c) {
+    epoll_event ev{};
+    ev.events = EPOLLIN | (c->wbuf.empty() && !c->connecting ? 0 : EPOLLOUT);
+    ev.data.fd = c->fd;
+    epoll_ctl(epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+  }
+
+  void add_conn(Conn *c) {
+    conns[c->id] = c;
+    by_fd[c->fd] = c->id;
+    epoll_event ev{};
+    ev.events = EPOLLIN | (c->connecting || !c->wbuf.empty() ? EPOLLOUT : 0);
+    ev.data.fd = c->fd;
+    epoll_ctl(epoll_fd, EPOLL_CTL_ADD, c->fd, &ev);
+  }
+
+  void drop_conn(Conn *c, bool notify) {
+    if (c->mode == 'B' && notify) {
+      Event ev{};
+      ev.type = EV_BI_CLOSED;
+      ev.conn_id = c->id;
+      ev.ip = c->ip;
+      ev.port = c->port;
+      push_event(std::move(ev));
+    }
+    if (c->outgoing && c->mode == 'U') {
+      auto it = uni_cache.find({c->ip, c->port});
+      if (it != uni_cache.end() && it->second == c->id) uni_cache.erase(it);
+    }
+    epoll_ctl(epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+    close(c->fd);
+    by_fd.erase(c->fd);
+    conns.erase(c->id);
+    delete c;
+  }
+
+  Conn *connect_out(const std::string &ip, int port, char mode, int64_t id) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    set_nonblock(fd);
+    int yes = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &yes, sizeof(yes));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, ip.c_str(), &sa.sin_addr) != 1) {
+      close(fd);
+      return nullptr;
+    }
+    int rc = connect(fd, (sockaddr *)&sa, sizeof(sa));
+    if (rc < 0 && errno != EINPROGRESS) {
+      close(fd);
+      return nullptr;
+    }
+    Conn *c = new Conn();
+    c->fd = fd;
+    c->id = id;
+    c->outgoing = true;
+    c->mode = mode;
+    c->connecting = true;
+    c->t0 = std::chrono::steady_clock::now();
+    c->ip = ip;
+    c->port = port;
+    c->wbuf.push_back((uint8_t)mode);  // magic byte leads the stream
+    add_conn(c);
+    return c;
+  }
+
+  void append_frame(Conn *c, const std::vector<uint8_t> &payload) {
+    uint32_t len = (uint32_t)payload.size();
+    uint8_t hdr[4] = {(uint8_t)(len >> 24), (uint8_t)(len >> 16),
+                      (uint8_t)(len >> 8), (uint8_t)len};
+    c->wbuf.insert(c->wbuf.end(), hdr, hdr + 4);
+    c->wbuf.insert(c->wbuf.end(), payload.begin(), payload.end());
+    arm(c);
+  }
+
+  void handle_cmd(Cmd &cmd) {
+    switch (cmd.type) {
+      case CMD_DGRAM: {
+        sockaddr_in sa{};
+        sa.sin_family = AF_INET;
+        sa.sin_port = htons((uint16_t)cmd.port);
+        if (inet_pton(AF_INET, cmd.ip.c_str(), &sa.sin_addr) == 1) {
+          sendto(udp_fd, cmd.data.data(), cmd.data.size(), 0, (sockaddr *)&sa,
+                 sizeof(sa));
+        }
+        break;
+      }
+      case CMD_UNI: {
+        auto key = std::make_pair(cmd.ip, cmd.port);
+        auto it = uni_cache.find(key);
+        Conn *c = nullptr;
+        if (it != uni_cache.end()) {
+          auto ci = conns.find(it->second);
+          if (ci != conns.end()) c = ci->second;
+        }
+        if (c == nullptr) {
+          c = connect_out(cmd.ip, cmd.port, 'U', next_id.fetch_add(1));
+          if (c == nullptr) break;  // unroutable; epidemic tolerates loss
+          uni_cache[key] = c->id;
+        }
+        append_frame(c, cmd.data);
+        break;
+      }
+      case CMD_BI_OPEN: {
+        Conn *c = connect_out(cmd.ip, cmd.port, 'B', cmd.conn_id);
+        if (c == nullptr) {
+          Event ev{};
+          ev.type = EV_BI_CLOSED;
+          ev.conn_id = cmd.conn_id;
+          ev.ip = cmd.ip;
+          ev.port = cmd.port;
+          push_event(std::move(ev));
+        }
+        break;
+      }
+      case CMD_BI_SEND: {
+        auto it = conns.find(cmd.conn_id);
+        if (it != conns.end()) append_frame(it->second, cmd.data);
+        break;
+      }
+      case CMD_BI_CLOSE: {
+        auto it = conns.find(cmd.conn_id);
+        if (it != conns.end()) drop_conn(it->second, false);
+        break;
+      }
+      default:
+        break;
+    }
+  }
+
+  void flush_write(Conn *c) {
+    if (c->connecting) {
+      int err = 0;
+      socklen_t len = sizeof(err);
+      getsockopt(c->fd, SOL_SOCKET, SO_ERROR, &err, &len);
+      if (err != 0) {
+        drop_conn(c, true);
+        return;
+      }
+      c->connecting = false;
+      double ms = std::chrono::duration<double, std::milli>(
+                      std::chrono::steady_clock::now() - c->t0)
+                      .count();
+      Event rtt{};
+      rtt.type = EV_RTT;
+      rtt.conn_id = c->id;
+      rtt.ip = c->ip;
+      rtt.port = c->port;
+      rtt.rtt_ms = ms;
+      push_event(std::move(rtt));
+      if (c->mode == 'B') {
+        Event ev{};
+        ev.type = EV_BI_CONNECTED;
+        ev.conn_id = c->id;
+        ev.ip = c->ip;
+        ev.port = c->port;
+        push_event(std::move(ev));
+      }
+    }
+    while (!c->wbuf.empty()) {
+      // contiguous run from the deque front
+      size_t run = 0;
+      uint8_t tmp[kReadChunk];
+      while (run < sizeof(tmp) && run < c->wbuf.size()) {
+        tmp[run] = c->wbuf[run];
+        run++;
+      }
+      ssize_t n = send(c->fd, tmp, run, MSG_NOSIGNAL);
+      if (n > 0) {
+        c->wbuf.erase(c->wbuf.begin(), c->wbuf.begin() + n);
+      } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        break;
+      } else {
+        drop_conn(c, true);
+        return;
+      }
+    }
+    arm(c);
+  }
+
+  void parse_frames(Conn *c) {
+    size_t off = 0;
+    if (c->mode == 0) {
+      if (c->rbuf.empty()) return;
+      char magic = (char)c->rbuf[0];
+      if (magic != 'U' && magic != 'B') {
+        drop_conn(c, false);  // unknown protocol: contain the peer
+        return;
+      }
+      c->mode = magic;
+      off = 1;
+      if (magic == 'B') {
+        Event ev{};
+        ev.type = EV_BI_ACCEPT;
+        ev.conn_id = c->id;
+        ev.ip = c->ip;
+        ev.port = c->port;
+        push_event(std::move(ev));
+      }
+    }
+    while (c->rbuf.size() - off >= 4) {
+      uint32_t len = ((uint32_t)c->rbuf[off] << 24) |
+                     ((uint32_t)c->rbuf[off + 1] << 16) |
+                     ((uint32_t)c->rbuf[off + 2] << 8) |
+                     (uint32_t)c->rbuf[off + 3];
+      if (len > kMaxFrame) {
+        drop_conn(c, true);
+        return;
+      }
+      if (c->rbuf.size() - off - 4 < len) break;
+      Event ev{};
+      ev.type = (c->mode == 'U') ? EV_UNI_FRAME : EV_BI_FRAME;
+      ev.conn_id = c->id;
+      ev.ip = c->ip;
+      ev.port = c->port;
+      ev.data.assign(c->rbuf.begin() + off + 4,
+                     c->rbuf.begin() + off + 4 + len);
+      push_event(std::move(ev));
+      off += 4 + len;
+    }
+    if (off > 0) c->rbuf.erase(c->rbuf.begin(), c->rbuf.begin() + off);
+  }
+
+  void handle_read(Conn *c) {
+    uint8_t buf[kReadChunk];
+    while (true) {
+      ssize_t n = recv(c->fd, buf, sizeof(buf), 0);
+      if (n > 0) {
+        c->rbuf.insert(c->rbuf.end(), buf, buf + n);
+        if (c->rbuf.size() > kMaxFrame + 5) {
+          drop_conn(c, true);  // runaway unframed sender
+          return;
+        }
+      } else if (n == 0) {
+        drop_conn(c, true);
+        return;
+      } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        break;
+      } else {
+        drop_conn(c, true);
+        return;
+      }
+    }
+    parse_frames(c);
+  }
+
+  void accept_loop() {
+    while (true) {
+      sockaddr_in sa{};
+      socklen_t slen = sizeof(sa);
+      int fd = accept(listen_fd, (sockaddr *)&sa, &slen);
+      if (fd < 0) break;
+      set_nonblock(fd);
+      int yes = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &yes, sizeof(yes));
+      char ipbuf[INET_ADDRSTRLEN] = {0};
+      inet_ntop(AF_INET, &sa.sin_addr, ipbuf, sizeof(ipbuf));
+      Conn *c = new Conn();
+      c->fd = fd;
+      c->id = next_id.fetch_add(1);
+      c->ip = ipbuf;
+      c->port = ntohs(sa.sin_port);
+      add_conn(c);
+    }
+  }
+
+  void udp_read() {
+    uint8_t buf[65536];
+    while (true) {
+      sockaddr_in sa{};
+      socklen_t slen = sizeof(sa);
+      ssize_t n =
+          recvfrom(udp_fd, buf, sizeof(buf), 0, (sockaddr *)&sa, &slen);
+      if (n < 0) break;
+      char ipbuf[INET_ADDRSTRLEN] = {0};
+      inet_ntop(AF_INET, &sa.sin_addr, ipbuf, sizeof(ipbuf));
+      Event ev{};
+      ev.type = EV_DGRAM;
+      ev.ip = ipbuf;
+      ev.port = ntohs(sa.sin_port);
+      ev.data.assign(buf, buf + n);
+      push_event(std::move(ev));
+    }
+  }
+
+  void run() {
+    epoll_event evs[64];
+    while (running.load()) {
+      int n = epoll_wait(epoll_fd, evs, 64, 500);
+      for (int i = 0; i < n; i++) {
+        int fd = evs[i].data.fd;
+        if (fd == wake_fd) {
+          uint64_t junk;
+          ssize_t r = read(wake_fd, &junk, sizeof(junk));
+          (void)r;
+          std::deque<Cmd> batch;
+          {
+            std::lock_guard<std::mutex> g(cmd_mu);
+            batch.swap(cmds);
+          }
+          for (auto &cmd : batch) {
+            if (cmd.type == CMD_STOP) {
+              running.store(false);
+              break;
+            }
+            handle_cmd(cmd);
+          }
+        } else if (fd == udp_fd) {
+          udp_read();
+        } else if (fd == listen_fd) {
+          accept_loop();
+        } else {
+          auto it = by_fd.find(fd);
+          if (it == by_fd.end()) continue;
+          Conn *c = conns[it->second];
+          if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+            if (c->connecting) {
+              drop_conn(c, true);
+              continue;
+            }
+          }
+          if (evs[i].events & EPOLLOUT) {
+            flush_write(c);
+            it = by_fd.find(fd);
+            if (it == by_fd.end()) continue;  // dropped during flush
+            c = conns[it->second];
+          }
+          if (evs[i].events & EPOLLIN) handle_read(c);
+        }
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Transport *corro_tp_create(const char *host, int port, int udp_fd,
+                           int tcp_fd) {
+  Transport *tp = new Transport();
+  tp->host = host;
+  if (udp_fd >= 0 && tcp_fd >= 0) {
+    tp->udp_fd = udp_fd;
+    tp->listen_fd = tcp_fd;
+    sockaddr_in sa{};
+    socklen_t slen = sizeof(sa);
+    getsockname(udp_fd, (sockaddr *)&sa, &slen);
+    tp->port = ntohs(sa.sin_port);
+  } else {
+    tp->udp_fd = socket(AF_INET, SOCK_DGRAM, 0);
+    tp->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+    int yes = 1;
+    setsockopt(tp->listen_fd, SOL_SOCKET, SO_REUSEADDR, &yes, sizeof(yes));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &sa.sin_addr) != 1 ||
+        bind(tp->udp_fd, (sockaddr *)&sa, sizeof(sa)) != 0) {
+      delete tp;
+      return nullptr;
+    }
+    socklen_t slen = sizeof(sa);
+    getsockname(tp->udp_fd, (sockaddr *)&sa, &slen);
+    tp->port = ntohs(sa.sin_port);
+    if (bind(tp->listen_fd, (sockaddr *)&sa, sizeof(sa)) != 0 ||
+        listen(tp->listen_fd, 128) != 0) {
+      delete tp;
+      return nullptr;
+    }
+  }
+  set_nonblock(tp->udp_fd);
+  set_nonblock(tp->listen_fd);
+  tp->epoll_fd = epoll_create1(0);
+  tp->wake_fd = eventfd(0, EFD_NONBLOCK);
+  tp->event_fd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = tp->wake_fd;
+  epoll_ctl(tp->epoll_fd, EPOLL_CTL_ADD, tp->wake_fd, &ev);
+  ev.data.fd = tp->udp_fd;
+  epoll_ctl(tp->epoll_fd, EPOLL_CTL_ADD, tp->udp_fd, &ev);
+  ev.data.fd = tp->listen_fd;
+  epoll_ctl(tp->epoll_fd, EPOLL_CTL_ADD, tp->listen_fd, &ev);
+  tp->running.store(true);
+  tp->loop_thread = std::thread([tp] { tp->run(); });
+  (void)now_ms_marker;
+  return tp;
+}
+
+int corro_tp_port(Transport *tp) { return tp->port; }
+int corro_tp_event_fd(Transport *tp) { return tp->event_fd; }
+
+int64_t corro_tp_next_conn_id(Transport *tp) {
+  return tp->next_id.fetch_add(1);
+}
+
+void corro_tp_send_datagram(Transport *tp, const char *ip, int port,
+                            const uint8_t *data, int len) {
+  Cmd cmd{};
+  cmd.type = CMD_DGRAM;
+  cmd.ip = ip;
+  cmd.port = port;
+  cmd.data.assign(data, data + len);
+  tp->enqueue_cmd(std::move(cmd));
+}
+
+void corro_tp_send_uni(Transport *tp, const char *ip, int port,
+                       const uint8_t *data, int len) {
+  Cmd cmd{};
+  cmd.type = CMD_UNI;
+  cmd.ip = ip;
+  cmd.port = port;
+  cmd.data.assign(data, data + len);
+  tp->enqueue_cmd(std::move(cmd));
+}
+
+void corro_tp_bi_open(Transport *tp, int64_t conn_id, const char *ip,
+                      int port) {
+  Cmd cmd{};
+  cmd.type = CMD_BI_OPEN;
+  cmd.conn_id = conn_id;
+  cmd.ip = ip;
+  cmd.port = port;
+  tp->enqueue_cmd(std::move(cmd));
+}
+
+void corro_tp_bi_send(Transport *tp, int64_t conn_id, const uint8_t *data,
+                      int len) {
+  Cmd cmd{};
+  cmd.type = CMD_BI_SEND;
+  cmd.conn_id = conn_id;
+  cmd.data.assign(data, data + len);
+  tp->enqueue_cmd(std::move(cmd));
+}
+
+void corro_tp_bi_close(Transport *tp, int64_t conn_id) {
+  Cmd cmd{};
+  cmd.type = CMD_BI_CLOSE;
+  cmd.conn_id = conn_id;
+  tp->enqueue_cmd(std::move(cmd));
+}
+
+// Event drain: returns 1 and fills the out-params when an event was
+// popped, 0 when the queue is empty.  ``*data`` is malloc'd (may be NULL
+// for dataless events) and must be released with corro_tp_free.
+int corro_tp_next_event(Transport *tp, int *type, int64_t *conn_id,
+                        char *ip_buf, int ip_cap, int *port,
+                        double *rtt_ms, uint8_t **data, int *data_len) {
+  Event ev;
+  {
+    std::lock_guard<std::mutex> g(tp->ev_mu);
+    if (tp->events.empty()) return 0;
+    ev = std::move(tp->events.front());
+    tp->events.pop_front();
+  }
+  *type = ev.type;
+  *conn_id = ev.conn_id;
+  snprintf(ip_buf, ip_cap, "%s", ev.ip.c_str());
+  *port = ev.port;
+  *rtt_ms = ev.rtt_ms;
+  if (ev.data.empty()) {
+    *data = nullptr;
+    *data_len = 0;
+  } else {
+    *data = (uint8_t *)malloc(ev.data.size());
+    memcpy(*data, ev.data.data(), ev.data.size());
+    *data_len = (int)ev.data.size();
+  }
+  return 1;
+}
+
+void corro_tp_free(uint8_t *ptr) { free(ptr); }
+
+void corro_tp_stop(Transport *tp) {
+  Cmd cmd{};
+  cmd.type = CMD_STOP;
+  tp->enqueue_cmd(std::move(cmd));
+  if (tp->loop_thread.joinable()) tp->loop_thread.join();
+  delete tp;
+}
+
+}  // extern "C"

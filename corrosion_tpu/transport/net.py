@@ -170,10 +170,20 @@ class Transport:
 
     async def start(self) -> Addr:
         loop = asyncio.get_running_loop()
+        if (
+            self._udp_sock is None or self._tcp_sock is None
+        ) and self.port == 0:
+            # an ephemeral UDP port's TCP twin may already be taken —
+            # binding the pair atomically with retries closes the race
+            # (the same EADDRINUSE class free_port() had)
+            self.port, self._udp_sock, self._tcp_sock = bind_port_pair(
+                self.host
+            )
         if self._udp_sock is not None:
             self._udp, _proto = await loop.create_datagram_endpoint(
                 lambda: _Datagram(self._handle_datagram), sock=self._udp_sock
             )
+            self._udp_sock = None  # transport owns it now
         else:
             self._udp, _proto = await loop.create_datagram_endpoint(
                 lambda: _Datagram(self._handle_datagram),
@@ -184,6 +194,7 @@ class Transport:
             self._tcp = await asyncio.start_server(
                 self._handle_conn, sock=self._tcp_sock, ssl=self.ssl_server
             )
+            self._tcp_sock = None
         else:
             self._tcp = await asyncio.start_server(
                 self._handle_conn, self.host, udp_port, ssl=self.ssl_server

@@ -80,6 +80,11 @@ class GossipConfig:
     # "python" (the executable spec in swim/core.py); both speak the same
     # wire and interoperate in one cluster
     swim_impl: str = "native"
+    # transport backend: "native" = the C++ epoll datagram+stream core
+    # (transport/native/, plaintext-only), "python" = asyncio sockets
+    # (required for TLS/mTLS).  Nodes of either impl interoperate — the
+    # wire format (magic byte + u32-BE frames) is identical.
+    transport_impl: str = "native"
 
 
 @dataclass
@@ -113,6 +118,10 @@ class AdminConfig:
 @dataclass
 class TelemetryConfig:
     prometheus_addr: Optional[str] = None
+    # OTLP trace export (ref: corrosion/src/main.rs:55-134): collector
+    # endpoint (OTLP/HTTP JSON) and/or a JSONL file sink
+    otlp_endpoint: Optional[str] = None
+    otlp_file: Optional[str] = None
 
 
 @dataclass

@@ -1,0 +1,184 @@
+"""OTLP trace export (OTLP/HTTP JSON).
+
+Equivalent of the reference's OpenTelemetry OTLP pipeline configured at
+CLI init (corrosion/src/main.rs:55-134: otlp exporter + resource
+attributes service/version/host).  Spans recorded by utils/tracing.py are
+batched and shipped as OTLP/HTTP JSON (``/v1/traces`` ResourceSpans) to a
+collector endpoint, and/or appended as JSON lines to a file — the file
+sink keeps traces observable in air-gapped environments where no
+collector is reachable.
+
+Like the metrics registry (utils/metrics.py), the span stream is
+process-global — one node per process in production, as in the
+reference.  An in-process multi-node harness should configure OTLP
+export on ONE node (each registered exporter sees every span in the
+process; per-node resource attribution is only meaningful
+process-per-node).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import socket
+from typing import List, Optional
+
+from . import tracing
+
+logger = logging.getLogger(__name__)
+
+EXPORT_INTERVAL = 5.0
+MAX_BATCH = 512  # spans per OTLP payload
+MAX_QUEUE = 8192  # drop-newest beyond this: tracing must not OOM the node
+SERVICE_VERSION = "0.1.0"
+
+
+def _attr(key: str, value: str) -> dict:
+    return {"key": key, "value": {"stringValue": str(value)}}
+
+
+def spans_to_otlp(
+    spans: List[tracing.SpanRecord],
+    service_name: str,
+    extra_attrs: Optional[dict] = None,
+) -> dict:
+    """OTLP/JSON ResourceSpans payload for one batch."""
+    resource_attrs = [
+        _attr("service.name", service_name),
+        _attr("service.version", SERVICE_VERSION),
+        _attr("host.name", socket.gethostname()),
+    ]
+    for k, v in (extra_attrs or {}).items():
+        resource_attrs.append(_attr(k, v))
+    return {
+        "resourceSpans": [
+            {
+                "resource": {"attributes": resource_attrs},
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "corrosion_tpu"},
+                        "spans": [
+                            {
+                                "traceId": s.trace_id,
+                                "spanId": s.span_id,
+                                **(
+                                    {"parentSpanId": s.parent_id}
+                                    if s.parent_id
+                                    else {}
+                                ),
+                                "name": s.name,
+                                "kind": 1,
+                                "startTimeUnixNano": str(
+                                    int(s.start * 1e9)
+                                ),
+                                "endTimeUnixNano": str(
+                                    int((s.start + s.duration) * 1e9)
+                                ),
+                                "attributes": [
+                                    _attr(k, v)
+                                    for k, v in s.attributes.items()
+                                ],
+                            }
+                            for s in spans
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+
+
+class OtlpExporter:
+    """Batching span exporter: OTLP/HTTP endpoint and/or JSONL file."""
+
+    def __init__(
+        self,
+        endpoint: Optional[str] = None,
+        file_path: Optional[str] = None,
+        service_name: str = "corrosion-tpu",
+        interval: float = EXPORT_INTERVAL,
+        extra_attrs: Optional[dict] = None,
+    ) -> None:
+        self.endpoint = endpoint
+        self.file_path = file_path
+        self.service_name = service_name
+        self.interval = interval
+        self.extra_attrs = extra_attrs or {}
+        self._queue: "asyncio.Queue[tracing.SpanRecord]" = asyncio.Queue(
+            maxsize=MAX_QUEUE
+        )
+        self._task: Optional[asyncio.Task] = None
+
+    # tracing hook interface
+    def enqueue(self, record: tracing.SpanRecord) -> None:
+        with contextlib.suppress(asyncio.QueueFull):
+            self._queue.put_nowait(record)
+
+    def start(self) -> "OtlpExporter":
+        tracing.add_exporter(self)
+        self._task = asyncio.create_task(self._run())
+        return self
+
+    async def stop(self) -> None:
+        tracing.remove_exporter(self)
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+        await self.flush_all()
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                await self.flush_all()
+            except Exception:
+                logger.debug("otlp flush failed", exc_info=True)
+
+    async def flush_all(self) -> int:
+        """Drain the whole backlog, one MAX_BATCH payload at a time."""
+        total = 0
+        while True:
+            n = await self.flush()
+            total += n
+            if n < MAX_BATCH:
+                return total
+
+    async def flush(self) -> int:
+        batch: List[tracing.SpanRecord] = []
+        while len(batch) < MAX_BATCH:
+            try:
+                batch.append(self._queue.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+        if not batch:
+            return 0
+        payload = spans_to_otlp(batch, self.service_name, self.extra_attrs)
+        if self.file_path:
+
+            def _append(path=self.file_path, blob=json.dumps(payload)):
+                with open(path, "a") as f:
+                    f.write(blob + "\n")
+
+            # keep the (possibly slow) filesystem off the event loop
+            await asyncio.to_thread(_append)
+        if self.endpoint:
+            try:
+                from aiohttp import ClientSession
+
+                async with ClientSession() as http:
+                    async with http.post(
+                        self.endpoint.rstrip("/") + "/v1/traces",
+                        json=payload,
+                        timeout=5,
+                    ) as resp:
+                        if resp.status >= 400:
+                            logger.warning(
+                                "otlp export rejected: %s", resp.status
+                            )
+            except Exception:
+                logger.debug("otlp http export failed", exc_info=True)
+        return len(batch)

@@ -7,9 +7,11 @@ particular the **cross-node trace propagation over the sync protocol**:
 (api/peer.rs:937-940) and extracted by ``serve_sync`` (peer.rs:1317-1319)
 so one sync round's client and server spans stitch into a single trace.
 
-No OTLP exporter exists in this environment; spans are recorded in a
-process-local ring buffer (inspectable in tests/debugging) and logged,
-with ids in W3C ``traceparent`` form (``00-<trace_id>-<span_id>-01``).
+Spans are recorded in a process-local ring buffer (inspectable in
+tests/debugging), logged with ids in W3C ``traceparent`` form
+(``00-<trace_id>-<span_id>-01``), and fanned out to any registered
+exporters — utils/otlp.py ships them as OTLP/HTTP JSON or JSONL files
+(the reference's OTLP pipeline, corrosion/src/main.rs:55-134).
 """
 
 from __future__ import annotations
@@ -81,6 +83,16 @@ _current: contextvars.ContextVar[Optional[TraceContext]] = (
     contextvars.ContextVar("corro_trace", default=None)
 )
 _spans: Deque[SpanRecord] = deque(maxlen=SPAN_BUFFER)
+_exporters: list = []  # objects with .enqueue(SpanRecord)
+
+
+def add_exporter(exporter) -> None:
+    _exporters.append(exporter)
+
+
+def remove_exporter(exporter) -> None:
+    with contextlib.suppress(ValueError):
+        _exporters.remove(exporter)
 
 
 def current_traceparent() -> Optional[str]:
@@ -126,6 +138,9 @@ def span(
             attributes={k: str(v) for k, v in attributes.items()},
         )
         _spans.append(record)
+        for exporter in _exporters:
+            with contextlib.suppress(Exception):
+                exporter.enqueue(record)
         logger.debug(
             "span %s trace=%s span=%s dur=%.4fs %s",
             name,

@@ -1,0 +1,85 @@
+"""Port of ``many_small_changes`` (agent/tests.rs:733-840): many nodes
+each spraying small single-row writes at random times CONCURRENTLY over
+the real HTTP API, then full convergence — the workload that stresses
+batched ingestion, dedup, and rebroadcast under overlapping write storms
+(scaled 10×100 → 10×50 writes for CI)."""
+
+import asyncio
+import random
+import time
+
+from aiohttp import ClientSession
+
+from corrosion_tpu.harness import DevCluster, Topology
+
+SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, "
+    'text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;'
+)
+
+N_NODES = 10
+WRITES_PER_NODE = 50
+
+
+def test_many_small_changes():
+    async def main():
+        rng = random.Random(4)
+        topo = Topology()
+        names = [f"m{i:02d}" for i in range(N_NODES)]
+        topo.edges[names[0]] = []
+        for i, name in enumerate(names[1:], 1):
+            # each node bootstraps off up to 3 random earlier nodes
+            # (ref: choose_multiple(rng, 10) over already-launched agents)
+            for peer in rng.sample(names[:i], min(3, i)):
+                topo.add_edge(name, peer)
+        async with DevCluster(topo, schema=SCHEMA) as cluster:
+            nodes = [cluster[name] for name in names]
+
+            async def writer(idx: int, node) -> None:
+                base = (idx + 1) * 100_000
+                async with ClientSession() as http:
+                    jobs = []
+                    for j in range(WRITES_PER_NODE):
+
+                        async def one(j=j):
+                            await asyncio.sleep(rng.uniform(0.05, 0.6))
+                            r = await http.post(
+                                f"{node.api_base}/v1/transactions",
+                                json=[[
+                                    "INSERT INTO tests (id,text) VALUES (?,?)",
+                                    [base + j, f"hello from {idx}"],
+                                ]],
+                            )
+                            assert r.status == 200, await r.text()
+
+                        jobs.append(one())
+                    await asyncio.gather(*jobs)
+
+            await asyncio.gather(
+                *(writer(i, node) for i, node in enumerate(nodes))
+            )
+
+            expected = N_NODES * WRITES_PER_NODE
+            deadline = time.monotonic() + 30.0
+            while True:
+                counts = [
+                    (
+                        await n.agent.pool.read_call(
+                            lambda c: c.execute(
+                                "SELECT COUNT(*) FROM tests"
+                            ).fetchone()
+                        )
+                    )[0]
+                    for n in nodes
+                ]
+                needs = [n.agent.generate_sync().need_len() for n in nodes]
+                if all(c == expected for c in counts) and not any(needs):
+                    break
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"no convergence: rows={sorted(counts)} "
+                        f"(want {expected}), needs={needs}"
+                    )
+                await asyncio.sleep(0.5)
+
+    asyncio.run(main())

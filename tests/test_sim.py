@@ -139,7 +139,7 @@ def test_swim_changes_rounds_under_churn():
     fanout away from dead nodes — round counts must actually change
     (VERDICT: configs 2 vs 3 must toggle SWIM features *with effect*)."""
     base = small_configs()["config4_churn"].with_(
-        swim=False, churn_ppm=250_000, churn_rounds=12, churn_down_rounds=6
+        swim=False, churn_ppm=300_000, churn_rounds=12, churn_down_rounds=4
     )
     on = base.with_(swim=True, swim_suspicion=True)
     r_off = cluster.run(base)

@@ -151,14 +151,21 @@ class BroadcastRuntime:
         abstraction the TPU round model (sim/model.py) is validated
         against.  No awaits: target draws cannot interleave with
         deliveries."""
-        prior = list(self.pending)
+        prior = sorted(self.pending, key=lambda pb: pb.payload)
         sends = []
+        fresh = []
         while True:
             try:
                 cv, rebroadcast = self._queue.get_nowait()
             except asyncio.QueueEmpty:
                 break
-            payload = encode_uni_broadcast(cv, self.cluster_id, rebroadcast)
+            fresh.append(encode_uni_broadcast(cv, self.cluster_id, rebroadcast))
+        # payloads are processed in sorted order so the seeded rng's draw
+        # sequence maps to payloads deterministically — ingestion batching
+        # makes ARRIVAL order run-dependent, which would otherwise
+        # desynchronize reproducible trials
+        fresh.sort()
+        for payload in fresh:
             sends.extend(
                 (m.addr, payload) for m in self._initial_targets(payload)
             )

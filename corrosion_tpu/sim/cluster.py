@@ -5,8 +5,9 @@ The whole cluster is one tensor program: node state is
   ``cov``    uint8[N, K]  chunk-coverage bitmask of changeset k at node n
                           (seq-range reassembly as boolean coverage masks,
                           SURVEY.md §5; complete ⇔ cov == full_mask[k])
-  ``budget`` int8[N, K]   remaining retransmissions (broadcast send_count,
-                          ref: PendingBroadcast, broadcast/mod.rs:747-773)
+  ``budget`` int8[N, K, S] remaining retransmissions PER CHUNK (each chunk
+                          payload is its own PendingBroadcast with its own
+                          send_count, broadcast/mod.rs:747-773)
   ``status`` int8[2, N]   SWIM membership view per partition side
                           (ALIVE/SUSPECT/DOWN — the foca state machine
                           driven by broadcast/mod.rs:162-374, vectorized)
@@ -87,8 +88,13 @@ def _consts(p: SimParams):
 
 
 def init_state(p: SimParams) -> SimState:
+    S = max(1, p.nseq_max)
     cov = jnp.zeros((p.n_nodes, p.n_changes), dtype=jnp.uint8)
-    budget = jnp.zeros((p.n_nodes, p.n_changes), dtype=jnp.int8)
+    # per-CHUNK retransmission budgets: the runtime re-sends each pending
+    # payload (= one chunk) on its own send_count (broadcast/mod.rs:
+    # 747-773); a shared per-changeset budget measurably over-disseminates
+    # (chunked-payload fidelity experiment, tests/test_sim_vs_harness.py)
+    budget = jnp.zeros((p.n_nodes, p.n_changes, S), dtype=jnp.int8)
     status = jnp.full((2, p.n_nodes), ALIVE, dtype=jnp.int8)
     since = jnp.zeros((2, p.n_nodes), dtype=jnp.int32)
     return cov, budget, status, since, jnp.int32(0)
@@ -236,7 +242,9 @@ def make_step(p: SimParams):
         cov = cov.at[origin, karange].max(
             jnp.where(inj, full[karange], jnp.uint8(0))
         )
-        budget = budget.at[origin, karange].max(jnp.where(inj, T8, jnp.int8(0)))
+        budget = budget.at[origin, karange, :].max(
+            jnp.where(inj, T8, jnp.int8(0))[:, None]
+        )
 
         # 2. SWIM probe / suspect / refute / rejoin (per-side views)
         if p.swim:
@@ -313,13 +321,13 @@ def make_step(p: SimParams):
         # bit (a max over mixed bit values would drop bits — OR semantics
         # needed); targets are [N, K] so the scatter is elementwise
         # (t[n, k], k) ← pay[n, k]
-        pend = jnp.logical_and(budget > 0, alive[:, None])
+        pend = jnp.logical_and(budget > 0, alive[:, None, None])  # [N,K,S]
         delivered = jnp.zeros_like(cov)
         kk = jnp.broadcast_to(kvec, (N, K))
         for s in range(S):
             bit = jnp.uint8(1 << s)
             plane = jnp.zeros((N, K), dtype=bool)
-            hold = jnp.logical_and(pend, (cov & bit).astype(bool))
+            hold = jnp.logical_and(pend[:, :, s], (cov & bit).astype(bool))
             if p.fanout_per_change:
                 chosen = []
                 for j in range(p.fanout):
@@ -351,12 +359,20 @@ def make_step(p: SimParams):
                     plane = plane.at[t].max(hold & ok[:, None])
             delivered = delivered | jnp.where(plane, bit, jnp.uint8(0))
 
-        # 4. receive: accumulate chunks, refresh budgets on new coverage
+        # 4. receive: accumulate chunks; a newly received chunk refreshes
+        # ITS OWN budget only (one pending payload per chunk, like the
+        # runtime); every pending chunk that sent this round decrements
         new_bits = delivered & ~cov
         new_bits = jnp.where(alive[:, None], new_bits, 0)
         cov = cov | new_bits
+        chunk_bits = jnp.asarray(
+            [1 << s for s in range(S)], dtype=jnp.uint8
+        )
+        new_per_chunk = (
+            new_bits[:, :, None] & chunk_bits[None, None, :]
+        ) != 0
         budget = jnp.where(
-            new_bits != 0,
+            new_per_chunk,
             T8,
             jnp.where(pend, budget - jnp.int8(1), budget),
         )
@@ -394,8 +410,8 @@ def make_step(p: SimParams):
             own_cov = jnp.where(own_now, full[None, :], 0).astype(jnp.uint8)
             cov = jnp.where(die[:, None], own_cov, cov)
             budget = jnp.where(
-                die[:, None],
-                jnp.where(own_now, T8, jnp.int8(0)),
+                die[:, None, None],
+                jnp.where(own_now[:, :, None], T8, jnp.int8(0)),
                 budget,
             )
         return cov, budget, status, since, r + 1
@@ -426,14 +442,18 @@ def state_shardings(
     node_axis: str = "nodes",
     change_axis: Optional[str] = None,
 ):
-    """Shardings matching ``init_state(p)``'s tuple, leaf by leaf: [N, K]
-    arrays shard (node_axis, change_axis), [N] arrays shard (node_axis,),
-    anything else — the [2, N] membership views, the scalar round counter —
+    """Shardings matching ``init_state(p)``'s tuple, leaf by leaf:
+    [N, K, S] arrays (the per-chunk budgets) shard
+    (node_axis, change_axis, None), [N, K] arrays shard
+    (node_axis, change_axis), [N] arrays shard (node_axis,), anything
+    else — the [2, N] membership views, the scalar round counter —
     replicates (None)."""
     out = []
     for x in jax.eval_shape(lambda: init_state(p)):
         ndim = getattr(x, "ndim", 0)
-        if ndim == 2 and x.shape[0] == p.n_nodes:
+        if ndim == 3 and x.shape[0] == p.n_nodes:
+            out.append(NamedSharding(mesh, P(node_axis, change_axis, None)))
+        elif ndim == 2 and x.shape[0] == p.n_nodes:
             out.append(NamedSharding(mesh, P(node_axis, change_axis)))
         elif ndim == 1 and x.shape[0] == p.n_nodes:
             out.append(NamedSharding(mesh, P(node_axis)))

@@ -52,10 +52,14 @@ calls for.  Per round, in order:
 
    Deliveries to dead nodes or across an active partition are lost.
 4. *Receive*: chunks landing on a live node accumulate in its coverage
-   mask (partial buffering, util.rs:1392-1511); any new chunk refreshes
-   that changeset's budget to ``max_transmissions`` (rebroadcast of
-   unseen broadcast-sourced changes, handlers.rs:530-538); senders
-   decrement budgets by 1 (send_count, broadcast/mod.rs:747-773).
+   mask (partial buffering, util.rs:1392-1511); a newly received chunk
+   refreshes ITS OWN retransmission budget to ``max_transmissions`` —
+   budgets are per (changeset, chunk), because each chunk payload is its
+   own pending broadcast with its own send_count in the runtime
+   (rebroadcast of unseen broadcast-sourced payloads, handlers.rs:530-538
+   + PendingBroadcast, broadcast/mod.rs:747-773; a shared per-changeset
+   budget measurably over-disseminated in the chunked-payload fidelity
+   experiment).  Every pending chunk that sent this round decrements by 1.
 5. *Anti-entropy* (every `sync_interval` rounds): each live node pulls
    from one believed-up peer the chunks the peer can serve under the
    reference's needs algebra — above-head versions fully, gap versions

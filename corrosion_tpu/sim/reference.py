@@ -152,7 +152,11 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
         return not any(death(r - d, n) for d in range(1, D + 1))
 
     cov: List[List[int]] = [[0] * K for _ in range(N)]
-    budget: List[List[int]] = [[0] * K for _ in range(N)]
+    # per-CHUNK budgets (mirrors sim.cluster: one PendingBroadcast per
+    # chunk payload in the runtime)
+    budget: List[List[List[int]]] = [
+        [[0] * S for _ in range(K)] for _ in range(N)
+    ]
     status: List[List[int]] = [[ALIVE] * N, [ALIVE] * N]
     since: List[List[int]] = [[0] * N, [0] * N]
     by_round = {}
@@ -183,7 +187,8 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
         # 1. inject
         for k in by_round.get(r, ()):  # noqa: B909 (read-only)
             cov[origin[k]][k] |= full[k]
-            budget[origin[k]][k] = max(budget[origin[k]][k], T)
+            for s in range(S):
+                budget[origin[k]][k][s] = max(budget[origin[k]][k][s], T)
 
         # 2. SWIM: probes against round-start views, then per-view updates
         if p.swim:
@@ -230,7 +235,11 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
         # distinct member sample, broadcast/runtime.py _resend_tick;
         # fidelity pinned by tests/test_sim_vs_harness.py)
         pend = [
-            [budget[n][k] > 0 and alive[n] for k in range(K)] for n in range(N)
+            [
+                [budget[n][k][s] > 0 and alive[n] for s in range(S)]
+                for k in range(K)
+            ]
+            for n in range(N)
         ]
         snap = [list(row) for row in cov]
         delivered: List[List[int]] = [[0] * K for _ in range(N)]
@@ -239,11 +248,9 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
                 continue
             if p.fanout_per_change:
                 for k in range(K):
-                    if not pend[n][k]:
-                        continue
                     for s in range(S):
                         bit = 1 << s
-                        if not snap[n][k] & bit:
+                        if not (pend[n][k][s] and snap[n][k] & bit):
                             continue
                         chosen: List[int] = []
                         for j in range(p.fanout):
@@ -278,18 +285,20 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
                             continue
                         bit = 1 << s
                         for k in range(K):
-                            if pend[n][k] and snap[n][k] & bit:
+                            if pend[n][k][s] and snap[n][k] & bit:
                                 delivered[t][k] |= bit
 
-        # 4. receive
+        # 4. receive: a new chunk refreshes ITS OWN budget only; every
+        # pending chunk that sent this round decrements
         for n in range(N):
             for k in range(K):
                 new = delivered[n][k] & ~cov[n][k] if alive[n] else 0
-                if new:
-                    cov[n][k] |= new
-                    budget[n][k] = T
-                elif pend[n][k]:
-                    budget[n][k] -= 1
+                cov[n][k] |= new
+                for s in range(S):
+                    if new & (1 << s):
+                        budget[n][k][s] = T
+                    elif pend[n][k][s]:
+                        budget[n][k][s] -= 1
 
         # 5. anti-entropy: budgeted needs-based pull (simultaneous snapshot)
         if p.sync_interval > 0 and (r + 1) % p.sync_interval == 0:
@@ -317,10 +326,10 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
                     for k in range(K):
                         if origin[k] == n and inject_round[k] <= r:
                             cov[n][k] = full[k]
-                            budget[n][k] = T
+                            budget[n][k] = [T] * S
                         else:
                             cov[n][k] = 0
-                            budget[n][k] = 0
+                            budget[n][k] = [0] * S
 
         # 7. convergence = every node holds every chunk of every changeset
         total = sum(

@@ -963,6 +963,26 @@ class PgServer:
         n = len(stmt.param_oids)
         bound = tuple(params) if params is not None else tuple([None] * n)
 
+        if _PG_CATALOG_RE.search(stmt.sql):
+            # catalog queries must probe the CATALOG db — a main-store
+            # probe would yield NoData and the later Execute would stream
+            # DataRows with no RowDescription (a protocol violation
+            # introspecting clients trip over)
+            def _describe_cat(conn):
+                return _catalog_query(
+                    conn,
+                    f"SELECT * FROM ({stmt.raw_sql.rstrip(';')}) LIMIT 0",
+                    bound,
+                )[0]
+
+            try:
+                desc = await self.agent.pool.read_call(_describe_cat)
+            except Exception:
+                out.no_data()
+                return
+            out.row_description([(name, OID_TEXT) for name in desc])
+            return
+
         def _describe(conn):
             # LIMIT 0 probe: column names without materializing rows
             cur = conn.execute(

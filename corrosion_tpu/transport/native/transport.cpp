@@ -96,8 +96,6 @@ struct Conn {
   std::deque<uint8_t> wbuf;
 };
 
-uint64_t now_ms_marker() { return 0; }
-
 int set_nonblock(int fd) {
   int fl = fcntl(fd, F_GETFL, 0);
   return fcntl(fd, F_SETFL, fl | O_NONBLOCK);
@@ -545,7 +543,6 @@ Transport *corro_tp_create(const char *host, int port, int udp_fd,
   epoll_ctl(tp->epoll_fd, EPOLL_CTL_ADD, tp->listen_fd, &ev);
   tp->running.store(true);
   tp->loop_thread = std::thread([tp] { tp->run(); });
-  (void)now_ms_marker;
   return tp;
 }
 

@@ -116,6 +116,11 @@ def test_bi_session_echo():
     run(main())
 
 
+def test_native_rejects_tls():
+    with pytest.raises(ValueError, match="plaintext-only"):
+        NativeTransport(ssl_server=object())
+
+
 def test_bi_connect_failure_raises():
     async def main():
         a, _ = await _mk(NativeTransport)

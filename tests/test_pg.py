@@ -499,6 +499,28 @@ def test_catalog_introspection():
     run(main())
 
 
+def test_catalog_over_extended_protocol():
+    """psycopg drives everything through Parse/Bind/Describe/Execute; a
+    catalog query must produce a RowDescription from Describe (probed
+    against the catalog DB) followed by DataRows."""
+
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+        cols, rows, _, errors, _ = await pg.extended(
+            "SELECT c.relname FROM pg_catalog.pg_class c WHERE "
+            "c.relkind = 'r' ORDER BY c.relname"
+        )
+        assert not errors, errors
+        assert cols == ["relname"]
+        assert ["tests"] in rows
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
 def test_password_auth():
     async def main():
         agent = Agent(AgentConfig(db_path=":memory:", read_conns=1)).open_sync()

@@ -94,13 +94,15 @@ def _converged(nodes, expected_heads):
     return True
 
 
-async def one_trial(cluster, nodes, trial_seed, sync_interval, expected_heads):
+async def one_trial(
+    cluster, nodes, trial_seed, k, sync_interval, expected_heads,
+    row_counts=None,
+):
     n = len(nodes)
     rng = random.Random(999_000 + trial_seed)
     for i, node in enumerate(nodes):
         node.broadcast.rng = random.Random((trial_seed + 1) * 1000 + i)
-    row_counts = getattr(cluster, "_chunk_row_counts", None)
-    for _ in range(cluster._k_per_trial):
+    for _ in range(k):
         origin = rng.randrange(n)
         node = nodes[origin]
         if row_counts is None:
@@ -201,8 +203,7 @@ async def harness_mean_rounds(n, k, mt, sync_interval, n_trials, nseq_max=1):
             },
         },
     )
-    cluster._k_per_trial = k
-    cluster._chunk_row_counts = (
+    row_counts = (
         await calibrate_chunk_rows(nseq_max) if nseq_max > 1 else None
     )
     await cluster.start()
@@ -219,7 +220,10 @@ async def harness_mean_rounds(n, k, mt, sync_interval, n_trials, nseq_max=1):
         rounds = []
         for t in range(n_trials):
             rounds.append(
-                await one_trial(cluster, nodes, t, sync_interval, expected_heads)
+                await one_trial(
+                    cluster, nodes, t, k, sync_interval, expected_heads,
+                    row_counts=row_counts,
+                )
             )
     finally:
         await cluster.stop()

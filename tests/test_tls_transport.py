@@ -191,3 +191,18 @@ def test_mtls_rejects_untrusted_node(certs, tmp_path):
             await n1.stop()
 
     run(main())
+
+
+def test_tls_config_falls_back_to_python_transport(certs):
+    """A TLS-configured node must run the python transport even when
+    transport_impl is 'native' (the native core is plaintext-only)."""
+    from corrosion_tpu.transport.net import Transport
+
+    async def main():
+        node = await boot_tls(certs)
+        try:
+            assert type(node.transport) is Transport
+        finally:
+            await node.stop()
+
+    run(main())

@@ -82,6 +82,12 @@ class Agent:
         self.pool.close()
         self._opened = False
 
+    async def aclose(self) -> None:
+        """Drain-aware close (pool.aclose) — what the node runtime uses;
+        in-flight thread work finishes before connections close."""
+        await self.pool.aclose()
+        self._opened = False
+
     def _restore_bookkeeping(self, conn: sqlite3.Connection) -> None:
         """Reload BookedVersions per actor (ref: BookedVersions::from_conn,
         agent.rs:1023-1077)."""

@@ -323,7 +323,10 @@ class Node:
             await self.api.stop()
         if self.transport is not None:
             await self.transport.stop()
-        self.agent.close()
+        # drain-aware: cancelled loops may have left threads mid-query
+        # (to_thread cannot interrupt them); closing connections under a
+        # running sqlite call segfaults the process
+        await self.agent.aclose()
         if self._subs_tmpdir is not None:
             self._subs_tmpdir.cleanup()
             self._subs_tmpdir = None

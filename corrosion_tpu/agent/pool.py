@@ -60,6 +60,9 @@ class SplitPool:
         self._opened = True
 
     def close(self) -> None:
+        """Synchronous close — callers must know no pool call is in
+        flight (single-owner test/tool contexts).  The node runtime uses
+        :meth:`aclose`, which waits for outstanding thread work first."""
         if self._write_conn is not None:
             with contextlib.suppress(Exception):
                 self._write_conn.execute("SELECT crsql_finalize()")
@@ -67,6 +70,50 @@ class SplitPool:
             self._write_conn = None
         while not self._read_pool.empty():
             self._read_pool.get_nowait().close()
+        if getattr(self, "_ephemeral", False):
+            for suffix in ("", "-wal", "-shm"):
+                with contextlib.suppress(OSError):
+                    os.unlink(self.path + suffix)
+        self._opened = False
+
+    async def aclose(self, timeout: float = 5.0) -> None:
+        """Close after draining: every read connection must come home and
+        the write permit must be free before connections close.  A
+        cancelled ``read_call``/``write_call`` awaiter leaves its thread
+        still executing on the connection (``to_thread`` cannot interrupt
+        a thread); closing underneath it is a C-level use-after-free in
+        sqlite (observed as a segfault in the announce loop's
+        ``__corro_members`` fallback read racing Node.stop)."""
+        if not self._opened:
+            return
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        drained = []
+        for _ in range(self._n_read):
+            remaining = max(0.05, deadline - _time.monotonic())
+            try:
+                drained.append(
+                    await asyncio.wait_for(self._read_pool.get(), remaining)
+                )
+            except asyncio.TimeoutError:
+                break  # leaked reader: better a leak than a use-after-free
+        for conn in drained:
+            conn.close()
+        remaining = max(0.05, deadline - _time.monotonic())
+        got_write = True
+        try:
+            await asyncio.wait_for(self._acquire_write(PRIORITY_HIGH), remaining)
+        except asyncio.TimeoutError:
+            got_write = False
+        if self._write_conn is not None:
+            with contextlib.suppress(Exception):
+                self._write_conn.execute("SELECT crsql_finalize()")
+            self._write_conn.close()
+            self._write_conn = None
+        if got_write:
+            with contextlib.suppress(RuntimeError):
+                self._release_write()
         if getattr(self, "_ephemeral", False):
             for suffix in ("", "-wal", "-shm"):
                 with contextlib.suppress(OSError):
@@ -84,8 +131,35 @@ class SplitPool:
             self._read_pool.put_nowait(conn)
 
     async def read_call(self, fn: Callable[[sqlite3.Connection], T]) -> T:
-        async with self.read() as conn:
-            return await asyncio.to_thread(fn, conn)
+        # shielded: if the awaiting task is cancelled, the inner task (and
+        # its thread) runs to completion and returns the connection via
+        # read()'s finally ON THE EVENT LOOP — the conn can never re-enter
+        # the pool while a thread is still executing on it
+        async def _do() -> T:
+            async with self.read() as conn:
+                return await asyncio.to_thread(fn, conn)
+
+        inner = asyncio.ensure_future(_do())
+        # a cancelled awaiter abandons the inner task: retrieve any late
+        # exception so the loop doesn't log "exception never retrieved"
+        inner.add_done_callback(lambda t: t.cancelled() or t.exception())
+        return await asyncio.shield(inner)
+
+    @staticmethod
+    async def thread_call(fn: Callable[..., T], *args) -> T:
+        """``to_thread`` that, when the awaiter is cancelled, WAITS for
+        the thread to finish before propagating the cancellation — for
+        callers holding a pool connection across several thread hops
+        (the streaming query path): the connection must be idle before
+        the enclosing ``read()`` returns it to the pool."""
+        fut = asyncio.ensure_future(asyncio.to_thread(fn, *args))
+        fut.add_done_callback(lambda t: t.cancelled() or t.exception())
+        try:
+            return await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            with contextlib.suppress(Exception):
+                await fut  # the thread cannot be interrupted; wait it out
+            raise
 
     # -- writes -----------------------------------------------------------
 
@@ -105,8 +179,15 @@ class SplitPool:
     async def write_call(
         self, fn: Callable[[sqlite3.Connection], T], priority: int = PRIORITY_NORMAL
     ) -> T:
-        async with self.write(priority) as conn:
-            return await asyncio.to_thread(fn, conn)
+        # shielded for the same reason as read_call — a cancelled awaiter
+        # must not release the write permit while its thread still writes
+        async def _do() -> T:
+            async with self.write(priority) as conn:
+                return await asyncio.to_thread(fn, conn)
+
+        inner = asyncio.ensure_future(_do())
+        inner.add_done_callback(lambda t: t.cancelled() or t.exception())
+        return await asyncio.shield(inner)
 
     async def _acquire_write(self, priority: int) -> None:
         if not self._write_lock.locked():

@@ -203,13 +203,18 @@ class Api:
         await resp.prepare(request)
 
         # stream in batches: the cursor lives on the read connection and is
-        # advanced via to_thread, so large results never sit fully in memory
-        # (the reference's query path streams row-by-row, mod.rs:353+);
-        # a client hanging up mid-stream just ends the response
+        # advanced via thread_call — cancellation-safe threading, so a
+        # disconnecting client (aiohttp cancels the handler) can't hand the
+        # connection back to the pool while a thread still runs on it —
+        # and large results never sit fully in memory (the reference's
+        # query path streams row-by-row, mod.rs:353+); a client hanging up
+        # mid-stream just ends the response
+        from ..agent.pool import SplitPool
+
         try:
             async with self.agent.pool.read() as conn:
                 try:
-                    cur = await asyncio.to_thread(conn.execute, sql, params)
+                    cur = await SplitPool.thread_call(conn.execute, sql, params)
                     cols = (
                         [d[0] for d in cur.description]
                         if cur.description
@@ -224,7 +229,7 @@ class Api:
                 await resp.write(json.dumps({"columns": cols}).encode() + b"\n")
                 rowid = 0
                 while True:
-                    batch = await asyncio.to_thread(cur.fetchmany, 500)
+                    batch = await SplitPool.thread_call(cur.fetchmany, 500)
                     if not batch:
                         break
                     out = bytearray()

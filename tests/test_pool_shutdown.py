@@ -1,0 +1,88 @@
+"""Pool shutdown vs in-flight thread work.
+
+``asyncio.to_thread`` cannot interrupt a running thread: when a
+``read_call``/``write_call`` awaiter is cancelled, its thread keeps
+executing on the connection.  Before the shielded-completion +
+drain-aware-close fix, the cancelled awaiter returned the connection to
+the pool and ``close()`` closed it UNDER the running sqlite call — a
+C-level use-after-free that segfaulted the whole test process (caught by
+repeated full-suite runs racing Node.stop against the announce loop's
+``__corro_members`` fallback read)."""
+
+import asyncio
+import time
+
+from corrosion_tpu.agent.pool import SplitPool
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _slow_read(conn):
+    # a real query plus thread-side dwell time, so cancellation reliably
+    # lands while the thread still holds the connection
+    conn.execute("SELECT 1").fetchone()
+    time.sleep(0.2)
+    return conn.execute("SELECT crsql_site_id()").fetchone()
+
+
+def test_cancelled_read_then_aclose_does_not_crash():
+    async def main():
+        pool = SplitPool(":memory:", read_conns=2)
+        pool.open()
+        task = asyncio.create_task(pool.read_call(_slow_read))
+        await asyncio.sleep(0.05)  # thread is inside _slow_read now
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        # must WAIT for the thread to finish before closing its conn
+        t0 = time.monotonic()
+        await pool.aclose()
+        assert time.monotonic() - t0 >= 0.1, (
+            "aclose did not wait for the in-flight reader"
+        )
+
+    run(main())
+
+
+def test_cancelled_write_keeps_permit_until_thread_done():
+    async def main():
+        pool = SplitPool(":memory:", read_conns=1)
+        pool.open()
+        order = []
+
+        def w1(conn):
+            order.append("w1-start")
+            time.sleep(0.15)
+            order.append("w1-end")
+
+        def w2(conn):
+            order.append("w2")
+
+        t1 = asyncio.create_task(pool.write_call(w1))
+        await asyncio.sleep(0.05)
+        t1.cancel()
+        try:
+            await t1
+        except asyncio.CancelledError:
+            pass
+        # a second writer must not run while w1's thread still writes
+        await pool.write_call(w2)
+        assert order == ["w1-start", "w1-end", "w2"], order
+        await pool.aclose()
+
+    run(main())
+
+
+def test_aclose_idempotent_and_reopenable():
+    async def main():
+        pool = SplitPool(":memory:", read_conns=1)
+        pool.open()
+        await pool.read_call(lambda c: c.execute("SELECT 1").fetchone())
+        await pool.aclose()
+        await pool.aclose()  # no-op
+
+    run(main())

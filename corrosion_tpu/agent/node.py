@@ -105,23 +105,9 @@ class Node:
 
         self.members = Members(self.agent.actor_id)
         self.sync_server = SyncServer(self.agent, cluster_id)
-        ssl_server = ssl_client = None
         tls = self.config.gossip.tls
-        if tls is not None and not self.config.gossip.plaintext:
-            from ..utils.tls import client_context, server_context
-
-            ssl_server = server_context(
-                tls.cert_file,
-                tls.key_file,
-                ca_file=tls.ca_file,
-                require_client_cert=tls.mtls,
-            )
-            ssl_client = client_context(
-                ca_file=tls.ca_file,
-                cert_file=tls.client_cert_file if tls.mtls else None,
-                key_file=tls.client_key_file if tls.mtls else None,
-                insecure=tls.insecure,
-            )
+        if self.config.gossip.plaintext:
+            tls = None
         udp_sock, tcp_sock = self._gossip_socks or (None, None)
         transport_cls = Transport
         t_impl = self.config.gossip.transport_impl
@@ -130,9 +116,7 @@ class Node:
                 f"gossip.transport_impl must be 'native' or 'python', "
                 f"got {t_impl!r}"
             )
-        if t_impl == "native" and ssl_server is None and ssl_client is None:
-            # TLS stays on the python path (the native core is the
-            # plaintext gossip mode, like the reference's quinn-plaintext)
+        if t_impl == "native":
             try:
                 from ..transport.native import (
                     NativeTransport,
@@ -146,18 +130,65 @@ class Node:
                 logger.warning(
                     "native transport unavailable (%s); using python", e
                 )
-        self.transport = transport_cls(
-            host=gossip_host,
-            port=gossip_port,
-            on_datagram=self._on_datagram,
-            on_uni_frame=self._on_uni_frame,
-            on_bi_stream=self._on_bi_stream,
-            ssl_server=ssl_server,
-            ssl_client=ssl_client,
-            udp_sock=udp_sock,
-            tcp_sock=tcp_sock,
-        )
-        addr = await self.transport.start()
+        def make_python_transport(u, t):
+            # python impl: TLS via ssl contexts
+            ssl_server = ssl_client = None
+            if tls is not None:
+                from ..utils.tls import client_context, server_context
+
+                ssl_server = server_context(
+                    tls.cert_file,
+                    tls.key_file,
+                    ca_file=tls.ca_file,
+                    require_client_cert=tls.mtls,
+                )
+                ssl_client = client_context(
+                    ca_file=tls.ca_file,
+                    cert_file=tls.client_cert_file if tls.mtls else None,
+                    key_file=tls.client_key_file if tls.mtls else None,
+                    insecure=tls.insecure,
+                )
+            return Transport(
+                host=gossip_host,
+                port=gossip_port,
+                on_datagram=self._on_datagram,
+                on_uni_frame=self._on_uni_frame,
+                on_bi_stream=self._on_bi_stream,
+                ssl_server=ssl_server,
+                ssl_client=ssl_client,
+                udp_sock=u,
+                tcp_sock=t,
+            )
+
+        if transport_cls is Transport:
+            self.transport = make_python_transport(udp_sock, tcp_sock)
+            addr = await self.transport.start()
+        else:
+            # native impl: TLS runs inside the C++ core (OpenSSL)
+            self.transport = transport_cls(
+                host=gossip_host,
+                port=gossip_port,
+                on_datagram=self._on_datagram,
+                on_uni_frame=self._on_uni_frame,
+                on_bi_stream=self._on_bi_stream,
+                udp_sock=udp_sock,
+                tcp_sock=tcp_sock,
+                tls=tls,
+            )
+            try:
+                addr = await self.transport.start()
+            except OSError as e:
+                # start()-time failures (e.g. libssl missing at runtime)
+                # fall back to the python transport like load-time ones;
+                # the native wrapper keeps its pre-bound sockets usable
+                # on a failed create
+                logger.warning(
+                    "native transport failed to start (%s); using python", e
+                )
+                u = getattr(self.transport, "_udp_sock", None) or udp_sock
+                t = getattr(self.transport, "_tcp_sock", None) or tcp_sock
+                self.transport = make_python_transport(u, t)
+                addr = await self.transport.start()
         logger.debug("transport: %s", type(self.transport).__name__)
         self.transport.on_rtt = lambda a, rtt: self._on_rtt(a, rtt)
 

@@ -9,11 +9,22 @@ this module adapts it to the exact interface of the Python
 protocol-layer changes (the same pattern as the native SWIM core,
 swim/native/__init__.py).
 
+TLS/mTLS runs inside the C++ core (OpenSSL over memory BIOs, parity
+with the reference's rustls endpoint configs, api/peer.rs:103-324);
+pass a :class:`corrosion_tpu.types.config.GossipTlsConfig` as ``tls``.
+The Python-impl ``ssl_server``/``ssl_client`` SSLContext kwargs are not
+accepted here — contexts cannot cross the C boundary.
+
 Event flow: the C loop signals an eventfd; asyncio watches it with
 ``loop.add_reader`` and drains the C event queue on wakeup, copying each
-payload once into Python bytes.  TLS stays on the Python implementation
-(config validation in agent/node.py): the native path is the plaintext
-gossip mode, like the reference's ``quinn-plaintext``.
+payload once into Python bytes.
+
+Send completion & backpressure: :meth:`NativeTransport.flush` awaits a
+barrier token — every byte enqueued before the call has reached the
+kernel when it resolves (the round-paced fidelity harness uses this as
+its settle precondition).  Senders self-limit: when the core's queued
+byte count crosses the high-water mark they await a flush, bounding the
+command queue the way quinn's flow control bounds the reference's.
 """
 
 from __future__ import annotations
@@ -21,6 +32,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import ctypes
+import itertools
 import os
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
@@ -35,6 +47,30 @@ EV_BI_FRAME = 4
 EV_BI_CLOSED = 5
 EV_BI_CONNECTED = 6
 EV_RTT = 7
+EV_FLUSHED = 8
+
+# corro_tp_stats slot names, in C-side StatSlot order
+STAT_NAMES = (
+    "datagrams_sent",
+    "datagrams_recv",
+    "datagram_bytes_sent",
+    "datagram_bytes_recv",
+    "frames_sent",
+    "frames_recv",
+    "stream_bytes_sent",
+    "stream_bytes_recv",
+    "conns_accepted",
+    "conns_connected",
+    "conns_dropped",
+    "conns_open",
+    "queued_bytes",
+    "handshakes_ok",
+    "handshakes_failed",
+)
+
+# Backpressure: senders await a flush once this many bytes sit in the
+# core's queues (command queue + TLS pending + socket write buffers).
+HIGH_WATER_BYTES = 8 * 1024 * 1024
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "transport.cpp")
@@ -50,13 +86,19 @@ def load() -> ctypes.CDLL:
         return _lib
     cmd = [
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        _SRC, "-o", "{tmp}",
+        _SRC, "-o", "{tmp}", "-ldl",
     ]
     path = build_if_stale(_SRC, _OUT, cmd)
     lib = ctypes.CDLL(path)
     lib.corro_tp_create.restype = ctypes.c_void_p
     lib.corro_tp_create.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int,  # tls_on
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,  # cert/key/ca
+        ctypes.c_int, ctypes.c_int,  # mtls, insecure
+        ctypes.c_char_p, ctypes.c_char_p,  # client cert/key
+        ctypes.c_int,  # stall_timeout_ms
+        ctypes.c_char_p, ctypes.c_int,  # err buf
     ]
     lib.corro_tp_port.restype = ctypes.c_int
     lib.corro_tp_port.argtypes = [ctypes.c_void_p]
@@ -81,6 +123,14 @@ def load() -> ctypes.CDLL:
     ]
     lib.corro_tp_bi_close.restype = None
     lib.corro_tp_bi_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.corro_tp_flush.restype = None
+    lib.corro_tp_flush.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.corro_tp_queued_bytes.restype = ctypes.c_uint64
+    lib.corro_tp_queued_bytes.argtypes = [ctypes.c_void_p]
+    lib.corro_tp_stats.restype = None
+    lib.corro_tp_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
     lib.corro_tp_next_event.restype = ctypes.c_int
     lib.corro_tp_next_event.argtypes = [
         ctypes.c_void_p,
@@ -111,6 +161,9 @@ class NativeFramedStream:
 
     async def send(self, payload: bytes) -> None:
         if self.closed:
+            raise ConnectionError("stream is closed")
+        await self._tp._backpressure()
+        if self.closed or self._tp._handle is None:
             raise ConnectionError("stream is closed")
         self._tp._lib.corro_tp_bi_send(
             self._tp._handle, self.conn_id, payload, len(payload)
@@ -156,14 +209,18 @@ class NativeTransport:
         ssl_client=None,
         udp_sock=None,
         tcp_sock=None,
+        tls=None,  # GossipTlsConfig: TLS runs inside the C++ core
+        stall_timeout_ms: int = 10000,
     ) -> None:
         if ssl_server is not None or ssl_client is not None:
             raise ValueError(
-                "native transport is plaintext-only; use the python "
-                "implementation for TLS/mTLS gossip"
+                "native transport takes TLS as a GossipTlsConfig via "
+                "``tls=``, not python ssl contexts"
             )
         self.host = host
         self.port = port
+        self.tls = tls
+        self.stall_timeout_ms = stall_timeout_ms
         self.on_datagram = on_datagram or (lambda a, d: None)
         self.on_uni_frame = on_uni_frame
         self.on_bi_stream = on_bi_stream
@@ -175,6 +232,8 @@ class NativeTransport:
         self._event_fd: Optional[int] = None
         self._streams: Dict[int, NativeFramedStream] = {}
         self._connect_waiters: Dict[int, asyncio.Future] = {}
+        self._flush_waiters: Dict[int, asyncio.Future] = {}
+        self._flush_tokens = itertools.count(1)
         self._tasks: set = set()
 
     async def start(self) -> Addr:
@@ -190,17 +249,44 @@ class NativeTransport:
                 self.host
             )
         if self._udp_sock is not None and self._tcp_sock is not None:
-            # hand off ownership of the pre-bound pair to the C loop
-            udp_fd = self._udp_sock.detach()
-            tcp_fd = self._tcp_sock.detach()
-            self._udp_sock = self._tcp_sock = None
+            # hand DUPLICATED fds to the C loop: on create failure the
+            # original sockets stay usable (the caller can fall back to
+            # the python transport on the same bound port); on success
+            # the originals are closed and the C core owns its dups
+            udp_fd = os.dup(self._udp_sock.fileno())
+            tcp_fd = os.dup(self._tcp_sock.fileno())
         else:
             udp_fd = tcp_fd = -1
+        tls = self.tls
+        err_buf = ctypes.create_string_buffer(256)
         self._handle = self._lib.corro_tp_create(
-            self.host.encode(), self.port, udp_fd, tcp_fd
+            self.host.encode(),
+            self.port,
+            udp_fd,
+            tcp_fd,
+            1 if tls is not None else 0,
+            (tls.cert_file if tls else "").encode(),
+            (tls.key_file if tls else "").encode(),
+            ((tls.ca_file if tls else None) or "").encode(),
+            1 if (tls and tls.mtls) else 0,
+            1 if (tls and tls.insecure) else 0,
+            ((tls.client_cert_file if tls and tls.mtls else None) or
+             "").encode(),
+            ((tls.client_key_file if tls and tls.mtls else None) or
+             "").encode(),
+            self.stall_timeout_ms,
+            err_buf,
+            256,
         )
         if not self._handle:
-            raise OSError("native transport failed to bind")
+            # the C side closed the dup'd fds; the originals in
+            # self._udp_sock/_tcp_sock remain bound and usable
+            reason = err_buf.value.decode() or "failed to bind"
+            raise OSError(f"native transport: {reason}")
+        if self._udp_sock is not None:
+            self._udp_sock.close()
+            self._tcp_sock.close()
+            self._udp_sock = self._tcp_sock = None
         self.port = self._lib.corro_tp_port(self._handle)
         self._event_fd = self._lib.corro_tp_event_fd(self._handle)
         asyncio.get_running_loop().add_reader(self._event_fd, self._drain)
@@ -219,6 +305,10 @@ class NativeTransport:
             if not fut.done():
                 fut.set_exception(ConnectionError("transport stopped"))
         self._connect_waiters.clear()
+        for fut in self._flush_waiters.values():
+            if not fut.done():
+                fut.set_result(False)
+        self._flush_waiters.clear()
         handle, self._handle = self._handle, None
         self._lib.corro_tp_stop(handle)
         for t in self._tasks:
@@ -233,6 +323,7 @@ class NativeTransport:
             )
 
     async def send_uni(self, addr: Addr, payload: bytes) -> None:
+        await self._backpressure()
         if self._handle is not None:
             self._lib.corro_tp_send_uni(
                 self._handle, addr[0].encode(), addr[1], payload, len(payload)
@@ -256,6 +347,53 @@ class NativeTransport:
         finally:
             self._connect_waiters.pop(conn_id, None)
         return stream
+
+    # -- flush / backpressure ---------------------------------------------
+
+    def queued_bytes(self) -> int:
+        if self._handle is None:
+            return 0
+        return int(self._lib.corro_tp_queued_bytes(self._handle))
+
+    async def flush(self, timeout: float = 30.0) -> None:
+        """Barrier: resolves once every byte enqueued before this call
+        has been handed to the kernel (or its connection died)."""
+        if self._handle is None:
+            return
+        token = next(self._flush_tokens)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._flush_waiters[token] = fut
+        self._lib.corro_tp_flush(self._handle, token)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        finally:
+            self._flush_waiters.pop(token, None)
+
+    async def _backpressure(self) -> None:
+        """Bound the command queue: when the core's queued bytes cross
+        the high-water mark, wait for the backlog to sink below it.
+        Polling (not a flush barrier) so one stalled peer cannot
+        head-of-line-block sends to healthy peers; the C core's stall
+        reaper drops dead connections and releases their bytes within
+        stall_timeout_ms, which bounds this wait."""
+        deadline = (
+            asyncio.get_running_loop().time()
+            + self.stall_timeout_ms / 1000.0
+            + 5.0
+        )
+        while self.queued_bytes() >= HIGH_WATER_BYTES:
+            if asyncio.get_running_loop().time() > deadline:
+                break
+            await asyncio.sleep(0.01)
+
+    def stats(self) -> Dict[str, int]:
+        """Transport counters (ref: the per-connection QUIC stats gauges,
+        transport.rs:235-419)."""
+        if self._handle is None:
+            return {name: 0 for name in STAT_NAMES}
+        buf = (ctypes.c_uint64 * len(STAT_NAMES))()
+        self._lib.corro_tp_stats(self._handle, buf, len(STAT_NAMES))
+        return {name: int(buf[i]) for i, name in enumerate(STAT_NAMES)}
 
     # -- event pump -------------------------------------------------------
 
@@ -326,3 +464,7 @@ class NativeTransport:
         elif etype == EV_RTT:
             if self.on_rtt is not None:
                 self.on_rtt(addr, rtt_ms)
+        elif etype == EV_FLUSHED:
+            waiter = self._flush_waiters.get(conn_id)
+            if waiter is not None and not waiter.done():
+                waiter.set_result(True)

@@ -1,4 +1,5 @@
-// Native transport core: UDP datagrams + framed TCP streams over epoll.
+// Native transport core: UDP datagrams + framed TCP streams over epoll,
+// with optional TLS 1.3 / mTLS on the stream channels.
 //
 // TPU-era equivalent of the reference's quinn-based transport layer
 // (crates/corro-agent/src/transport.rs): three channel classes on one
@@ -7,26 +8,48 @@
 // sessions — with cached outgoing connections and connect-time RTT
 // sampling fed back to the member rings (transport.rs:55-76, 220).
 // QUIC itself is not reimplemented; the channel semantics the protocol
-// machines rely on are provided over UDP + TCP (the reference's
-// gossip.plaintext mode), and TLS stays on the Python path.
+// machines rely on are provided over UDP + TCP.  Encryption parity with
+// the reference's rustls server/client configs (api/peer.rs:103-324,
+// mTLS :133-210): TLS 1.3 on every stream channel, CA verification,
+// optional required client certificates, optional insecure mode.  SWIM
+// datagrams stay plaintext — the reference encrypts them only because
+// QUIC does; the stream channels carry the actual data.
+//
+// OpenSSL is loaded at runtime with dlopen (this image ships
+// libssl.so.3 without development headers, so the needed prototypes are
+// declared locally); plaintext transports never touch it.
 //
 // Threading model: one event-loop thread owns every socket.  Callers
-// enqueue commands (send datagram / send uni frame / open-send-close bi)
-// into a mutex-protected queue and wake the loop via eventfd; the loop
-// pushes events (received datagrams/frames, accepts, closes, RTT
-// samples) into a second queue and signals a second eventfd that the
-// Python side watches with asyncio's add_reader.  No Python locks are
-// ever held inside the loop; payloads are copied at both boundaries.
+// enqueue commands (send datagram / send uni frame / open-send-close bi
+// / flush) into a mutex-protected queue and wake the loop via eventfd;
+// the loop pushes events (received datagrams/frames, accepts, closes,
+// RTT samples, flush completions) into a second queue and signals a
+// second eventfd that the Python side watches with asyncio's
+// add_reader.  No Python locks are ever held inside the loop; payloads
+// are copied at both boundaries.
+//
+// Send completion & backpressure: CMD_FLUSH carries a token; because
+// commands are handled in order, every send enqueued before the flush
+// has reached a connection write buffer by the time the flush is
+// handled, and EV_FLUSHED fires once those buffers (and any in-flight
+// handshakes) drain into the kernel.  A relaxed atomic tracks the total
+// bytes queued anywhere (command queue, TLS pending plaintext, socket
+// write buffers); the Python side reads it and awaits a flush when it
+// crosses the high-water mark, bounding the queue (the reference relies
+// on quinn's per-stream flow control for the same property).
 //
 // Wire format: 1 magic byte per connection ('U' uni / 'B' bi), then
-// u32-BE length-delimited frames (corrosion_tpu/wire.py framing).
+// u32-BE length-delimited frames (corrosion_tpu/wire.py framing).  With
+// TLS the magic byte and frames ride inside the TLS stream.
 
 #include <arpa/inet.h>
+#include <dlfcn.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <stdint.h>
+#include <stdio.h>
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
@@ -38,6 +61,7 @@
 #include <deque>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -55,6 +79,7 @@ enum EventType {
   EV_BI_CLOSED = 5,
   EV_BI_CONNECTED = 6,
   EV_RTT = 7,
+  EV_FLUSHED = 8,  // conn_id carries the flush token
 };
 
 enum CmdType {
@@ -64,7 +89,154 @@ enum CmdType {
   CMD_BI_SEND = 4,
   CMD_BI_CLOSE = 5,
   CMD_STOP = 6,
+  CMD_FLUSH = 7,  // conn_id carries the flush token
 };
+
+// Stats slot indices (corro_tp_stats fills an array in this order; keep
+// in sync with NativeTransport.stats()).
+enum StatSlot {
+  ST_DGRAM_SENT = 0,
+  ST_DGRAM_RECV = 1,
+  ST_DGRAM_BYTES_SENT = 2,
+  ST_DGRAM_BYTES_RECV = 3,
+  ST_FRAMES_SENT = 4,
+  ST_FRAMES_RECV = 5,
+  ST_STREAM_BYTES_SENT = 6,
+  ST_STREAM_BYTES_RECV = 7,
+  ST_CONNS_ACCEPTED = 8,
+  ST_CONNS_CONNECTED = 9,
+  ST_CONNS_DROPPED = 10,
+  ST_CONNS_OPEN = 11,
+  ST_QUEUED_BYTES = 12,
+  ST_HANDSHAKES_OK = 13,
+  ST_HANDSHAKES_FAILED = 14,
+  ST_COUNT = 15,
+};
+
+// ---------------------------------------------------------------------------
+// Minimal OpenSSL 3 surface, resolved at runtime with dlopen/dlsym.
+// Opaque pointers throughout; constants from the stable public ABI.
+
+constexpr int kSslFiletypePem = 1;
+constexpr int kSslVerifyNone = 0;
+constexpr int kSslVerifyPeer = 1;
+constexpr int kSslVerifyFailIfNoPeerCert = 2;
+constexpr int kSslErrorWantRead = 2;
+constexpr int kSslErrorWantWrite = 3;
+constexpr int kSslErrorZeroReturn = 6;
+constexpr long kSslCtrlSetMinProtoVersion = 123;
+constexpr long kTls13Version = 0x0304;
+constexpr int kBioCtrlPending = 10;
+
+struct SslApi {
+  bool loaded = false;
+  void *ssl_so = nullptr;
+  void *crypto_so = nullptr;
+
+  const void *(*TLS_server_method)();
+  const void *(*TLS_client_method)();
+  void *(*SSL_CTX_new)(const void *);
+  void (*SSL_CTX_free)(void *);
+  long (*SSL_CTX_ctrl)(void *, int, long, void *);
+  int (*SSL_CTX_use_certificate_chain_file)(void *, const char *);
+  int (*SSL_CTX_use_PrivateKey_file)(void *, const char *, int);
+  int (*SSL_CTX_load_verify_locations)(void *, const char *, const char *);
+  int (*SSL_CTX_set_default_verify_paths)(void *);
+  void (*SSL_CTX_set_verify)(void *, int, void *);
+  void *(*SSL_new)(void *);
+  void (*SSL_free)(void *);
+  void (*SSL_set_bio)(void *, void *, void *);
+  void (*SSL_set_accept_state)(void *);
+  void (*SSL_set_connect_state)(void *);
+  int (*SSL_do_handshake)(void *);
+  int (*SSL_read)(void *, void *, int);
+  int (*SSL_write)(void *, const void *, int);
+  int (*SSL_get_error)(const void *, int);
+  void *(*SSL_get0_param)(void *);
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(void *, const char *);
+  int (*X509_VERIFY_PARAM_set1_host)(void *, const char *, size_t);
+  void *(*BIO_new)(const void *);
+  const void *(*BIO_s_mem)();
+  int (*BIO_read)(void *, void *, int);
+  int (*BIO_write)(void *, const void *, int);
+  long (*BIO_ctrl)(void *, int, long, void *);
+  unsigned long (*ERR_get_error)();
+  void (*ERR_error_string_n)(unsigned long, char *, size_t);
+  void (*ERR_clear_error)();
+};
+
+void *sym(void *a, void *b, const char *name) {
+  void *p = a ? dlsym(a, name) : nullptr;
+  if (p == nullptr && b) p = dlsym(b, name);
+  return p;
+}
+
+// Loads libssl/libcrypto once per process.  Returns nullptr (with a
+// message in *err) when the runtime libraries are unavailable.
+SslApi *load_ssl_api(std::string *err) {
+  static SslApi api;
+  static std::mutex mu;
+  static bool attempted = false;
+  std::lock_guard<std::mutex> g(mu);
+  if (api.loaded) return &api;
+  if (attempted) {
+    *err = "libssl unavailable (previous load failed)";
+    return nullptr;
+  }
+  attempted = true;
+  api.ssl_so = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+  if (api.ssl_so == nullptr)
+    api.ssl_so = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+  api.crypto_so = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+  if (api.crypto_so == nullptr)
+    api.crypto_so = dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+  if (api.ssl_so == nullptr) {
+    *err = "dlopen(libssl.so.3) failed";
+    return nullptr;
+  }
+#define RESOLVE(field)                                                   \
+  do {                                                                   \
+    api.field = reinterpret_cast<decltype(api.field)>(                   \
+        sym(api.ssl_so, api.crypto_so, #field));                         \
+    if (api.field == nullptr) {                                          \
+      *err = std::string("dlsym failed: ") + #field;                     \
+      return nullptr;                                                    \
+    }                                                                    \
+  } while (0)
+  RESOLVE(TLS_server_method);
+  RESOLVE(TLS_client_method);
+  RESOLVE(SSL_CTX_new);
+  RESOLVE(SSL_CTX_free);
+  RESOLVE(SSL_CTX_ctrl);
+  RESOLVE(SSL_CTX_use_certificate_chain_file);
+  RESOLVE(SSL_CTX_use_PrivateKey_file);
+  RESOLVE(SSL_CTX_load_verify_locations);
+  RESOLVE(SSL_CTX_set_default_verify_paths);
+  RESOLVE(SSL_CTX_set_verify);
+  RESOLVE(SSL_new);
+  RESOLVE(SSL_free);
+  RESOLVE(SSL_set_bio);
+  RESOLVE(SSL_set_accept_state);
+  RESOLVE(SSL_set_connect_state);
+  RESOLVE(SSL_do_handshake);
+  RESOLVE(SSL_read);
+  RESOLVE(SSL_write);
+  RESOLVE(SSL_get_error);
+  RESOLVE(SSL_get0_param);
+  RESOLVE(X509_VERIFY_PARAM_set1_ip_asc);
+  RESOLVE(X509_VERIFY_PARAM_set1_host);
+  RESOLVE(BIO_new);
+  RESOLVE(BIO_s_mem);
+  RESOLVE(BIO_read);
+  RESOLVE(BIO_write);
+  RESOLVE(BIO_ctrl);
+  RESOLVE(ERR_get_error);
+  RESOLVE(ERR_error_string_n);
+  RESOLVE(ERR_clear_error);
+#undef RESOLVE
+  api.loaded = true;
+  return &api;
+}
 
 struct Event {
   int type;
@@ -90,10 +262,27 @@ struct Conn {
   char mode = 0;  // 0 = inbound awaiting magic; 'U' or 'B'
   bool connecting = false;
   std::chrono::steady_clock::time_point t0;
+  // last forward progress (connect, byte moved, handshake step) — conns
+  // stalled mid-connect/handshake/write beyond the stall timeout are
+  // dropped so one dead peer can never wedge a flush barrier (the
+  // reference aborts sends >5 s the same way, api/peer.rs:611-667)
+  std::chrono::steady_clock::time_point last_progress;
   std::string ip;
   int port = 0;
-  std::vector<uint8_t> rbuf;
-  std::deque<uint8_t> wbuf;
+  std::vector<uint8_t> rbuf;   // plaintext (after TLS decrypt when on)
+  std::deque<uint8_t> wbuf;    // ciphertext/raw bytes bound for the kernel
+  // TLS state (null when the transport is plaintext)
+  void *ssl = nullptr;
+  void *rbio = nullptr;  // network -> SSL
+  void *wbio = nullptr;  // SSL -> network
+  bool handshaking = false;
+  std::vector<uint8_t> plain_pending;  // plaintext queued during handshake
+};
+
+// A flush token waits for this set of connections to fully drain.
+struct FlushWaiter {
+  int64_t token;
+  std::set<int64_t> conns;
 };
 
 int set_nonblock(int fd) {
@@ -122,17 +311,43 @@ struct Transport {
   std::map<int64_t, Conn *> conns;            // by id
   std::map<int, int64_t> by_fd;               // fd -> id
   std::map<std::pair<std::string, int>, int64_t> uni_cache;
+  std::vector<FlushWaiter> flush_waiters;
+
+  // TLS contexts (null when plaintext)
+  SslApi *ssl_api = nullptr;
+  void *server_ctx = nullptr;
+  void *client_ctx = nullptr;
+  bool tls_insecure = false;
+  int stall_timeout_ms = 10000;
+
+  std::atomic<uint64_t> stats[ST_COUNT] = {};
 
   ~Transport() {
     for (auto &kv : conns) {
-      if (kv.second->fd >= 0) close(kv.second->fd);
-      delete kv.second;
+      Conn *c = kv.second;
+      if (c->ssl != nullptr && ssl_api != nullptr) ssl_api->SSL_free(c->ssl);
+      if (c->fd >= 0) close(c->fd);
+      delete c;
+    }
+    if (ssl_api != nullptr) {
+      if (server_ctx != nullptr) ssl_api->SSL_CTX_free(server_ctx);
+      if (client_ctx != nullptr) ssl_api->SSL_CTX_free(client_ctx);
     }
     if (udp_fd >= 0) close(udp_fd);
     if (listen_fd >= 0) close(listen_fd);
     if (epoll_fd >= 0) close(epoll_fd);
     if (wake_fd >= 0) close(wake_fd);
     if (event_fd >= 0) close(event_fd);
+  }
+
+  void bump(int slot, uint64_t n = 1) {
+    stats[slot].fetch_add(n, std::memory_order_relaxed);
+  }
+  void queued_add(uint64_t n) {
+    stats[ST_QUEUED_BYTES].fetch_add(n, std::memory_order_relaxed);
+  }
+  void queued_sub(uint64_t n) {
+    stats[ST_QUEUED_BYTES].fetch_sub(n, std::memory_order_relaxed);
   }
 
   void push_event(Event &&ev) {
@@ -146,6 +361,7 @@ struct Transport {
   }
 
   void enqueue_cmd(Cmd &&cmd) {
+    queued_add(cmd.data.size());
     {
       std::lock_guard<std::mutex> g(cmd_mu);
       cmds.push_back(std::move(cmd));
@@ -163,12 +379,37 @@ struct Transport {
   }
 
   void add_conn(Conn *c) {
+    c->last_progress = std::chrono::steady_clock::now();
     conns[c->id] = c;
     by_fd[c->fd] = c->id;
+    stats[ST_CONNS_OPEN].store(conns.size(), std::memory_order_relaxed);
     epoll_event ev{};
     ev.events = EPOLLIN | (c->connecting || !c->wbuf.empty() ? EPOLLOUT : 0);
     ev.data.fd = c->fd;
     epoll_ctl(epoll_fd, EPOLL_CTL_ADD, c->fd, &ev);
+  }
+
+  // True while this connection still owes bytes to the kernel.  Inbound
+  // connections mid-handshake with nothing buffered owe us nothing — a
+  // flush must not wait on a peer's handshake progress.
+  bool conn_pending(const Conn *c) const {
+    return !c->wbuf.empty() || !c->plain_pending.empty() ||
+           (c->outgoing && (c->connecting || c->handshaking));
+  }
+
+  void flush_waiters_conn_done(int64_t id) {
+    for (size_t i = 0; i < flush_waiters.size();) {
+      flush_waiters[i].conns.erase(id);
+      if (flush_waiters[i].conns.empty()) {
+        Event ev{};
+        ev.type = EV_FLUSHED;
+        ev.conn_id = flush_waiters[i].token;
+        push_event(std::move(ev));
+        flush_waiters.erase(flush_waiters.begin() + i);
+      } else {
+        i++;
+      }
+    }
   }
 
   void drop_conn(Conn *c, bool notify) {
@@ -184,12 +425,149 @@ struct Transport {
       auto it = uni_cache.find({c->ip, c->port});
       if (it != uni_cache.end() && it->second == c->id) uni_cache.erase(it);
     }
+    queued_sub(c->wbuf.size() + c->plain_pending.size());
+    if (c->ssl != nullptr && ssl_api != nullptr) {
+      ssl_api->SSL_free(c->ssl);  // frees both memory BIOs
+      c->ssl = nullptr;
+    }
     epoll_ctl(epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
     close(c->fd);
     by_fd.erase(c->fd);
+    int64_t id = c->id;
     conns.erase(c->id);
     delete c;
+    bump(ST_CONNS_DROPPED);
+    stats[ST_CONNS_OPEN].store(conns.size(), std::memory_order_relaxed);
+    flush_waiters_conn_done(id);
   }
+
+  // -- TLS helpers --------------------------------------------------------
+
+  // Attach an SSL object (server or client role) with memory BIOs.
+  bool tls_attach(Conn *c, bool server_role) {
+    void *ctx = server_role ? server_ctx : client_ctx;
+    if (ctx == nullptr) return true;  // plaintext transport
+    c->ssl = ssl_api->SSL_new(ctx);
+    if (c->ssl == nullptr) return false;
+    c->rbio = ssl_api->BIO_new(ssl_api->BIO_s_mem());
+    c->wbio = ssl_api->BIO_new(ssl_api->BIO_s_mem());
+    ssl_api->SSL_set_bio(c->ssl, c->rbio, c->wbio);
+    if (server_role) {
+      ssl_api->SSL_set_accept_state(c->ssl);
+    } else {
+      ssl_api->SSL_set_connect_state(c->ssl);
+      if (!tls_insecure) {
+        // verify the peer certificate against the connect address
+        // (IP SAN first — members are addressed by IP — DNS fallback)
+        void *param = ssl_api->SSL_get0_param(c->ssl);
+        if (ssl_api->X509_VERIFY_PARAM_set1_ip_asc(param, c->ip.c_str()) !=
+            1) {
+          ssl_api->X509_VERIFY_PARAM_set1_host(param, c->ip.c_str(),
+                                               c->ip.size());
+        }
+      }
+    }
+    c->handshaking = true;
+    return true;
+  }
+
+  // Move ciphertext produced by SSL into the socket write buffer.
+  void tls_drain_wbio(Conn *c) {
+    uint8_t tmp[kReadChunk];
+    while (true) {
+      long pending = ssl_api->BIO_ctrl(c->wbio, kBioCtrlPending, 0, nullptr);
+      if (pending <= 0) break;
+      int n = ssl_api->BIO_read(c->wbio, tmp, (int)sizeof(tmp));
+      if (n <= 0) break;
+      c->wbuf.insert(c->wbuf.end(), tmp, tmp + n);
+      queued_add((uint64_t)n);
+    }
+  }
+
+  // Feed queued plaintext through SSL_write (memory BIOs always accept
+  // the full write, so no partial-write bookkeeping is needed).
+  bool tls_write_plain(Conn *c, const uint8_t *data, size_t len) {
+    size_t off = 0;
+    while (off < len) {
+      ssl_api->ERR_clear_error();
+      int n = ssl_api->SSL_write(c->ssl, data + off, (int)(len - off));
+      if (n <= 0) return false;
+      off += (size_t)n;
+    }
+    return true;
+  }
+
+  // Progress the handshake; returns false when the connection died.
+  bool tls_handshake_step(Conn *c) {
+    if (!c->handshaking) return true;
+    ssl_api->ERR_clear_error();
+    int r = ssl_api->SSL_do_handshake(c->ssl);
+    if (r == 1) {
+      c->handshaking = false;
+      c->last_progress = std::chrono::steady_clock::now();
+      bump(ST_HANDSHAKES_OK);
+      if (c->outgoing) {
+        // RTT includes the TLS handshake, like the reference's QUIC
+        // connect (transport.rs:220)
+        double ms = std::chrono::duration<double, std::milli>(
+                        std::chrono::steady_clock::now() - c->t0)
+                        .count();
+        Event rtt{};
+        rtt.type = EV_RTT;
+        rtt.conn_id = c->id;
+        rtt.ip = c->ip;
+        rtt.port = c->port;
+        rtt.rtt_ms = ms;
+        push_event(std::move(rtt));
+        if (c->mode == 'B') {
+          Event ev{};
+          ev.type = EV_BI_CONNECTED;
+          ev.conn_id = c->id;
+          ev.ip = c->ip;
+          ev.port = c->port;
+          push_event(std::move(ev));
+        }
+      }
+      if (!c->plain_pending.empty()) {
+        bool ok = tls_write_plain(c, c->plain_pending.data(),
+                                  c->plain_pending.size());
+        queued_sub(c->plain_pending.size());
+        c->plain_pending.clear();
+        if (!ok) {
+          tls_drain_wbio(c);
+          return false;
+        }
+      }
+      tls_drain_wbio(c);
+      if (!conn_pending(c)) flush_waiters_conn_done(c->id);
+      return true;
+    }
+    int err = ssl_api->SSL_get_error(c->ssl, r);
+    tls_drain_wbio(c);  // handshake records to send, if any
+    if (err == kSslErrorWantRead || err == kSslErrorWantWrite) return true;
+    bump(ST_HANDSHAKES_FAILED);
+    return false;
+  }
+
+  // Decrypt whatever SSL has buffered into the plaintext rbuf.
+  // Returns false when the connection died.
+  bool tls_read_plain(Conn *c) {
+    uint8_t tmp[kReadChunk];
+    while (true) {
+      ssl_api->ERR_clear_error();
+      int n = ssl_api->SSL_read(c->ssl, tmp, (int)sizeof(tmp));
+      if (n > 0) {
+        c->rbuf.insert(c->rbuf.end(), tmp, tmp + n);
+        if (c->rbuf.size() > kMaxFrame + 5) return false;
+        continue;
+      }
+      int err = ssl_api->SSL_get_error(c->ssl, n);
+      if (err == kSslErrorWantRead || err == kSslErrorWantWrite) return true;
+      return false;  // ZERO_RETURN (clean TLS close) or a real error
+    }
+  }
+
+  // -- outgoing -----------------------------------------------------------
 
   Conn *connect_out(const std::string &ip, int port, char mode, int64_t id) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
@@ -218,7 +596,19 @@ struct Transport {
     c->t0 = std::chrono::steady_clock::now();
     c->ip = ip;
     c->port = port;
-    c->wbuf.push_back((uint8_t)mode);  // magic byte leads the stream
+    if (client_ctx != nullptr) {
+      if (!tls_attach(c, false)) {
+        close(fd);
+        delete c;
+        return nullptr;
+      }
+      // magic byte rides inside TLS, after the handshake
+      c->plain_pending.push_back((uint8_t)mode);
+      queued_add(1);
+    } else {
+      c->wbuf.push_back((uint8_t)mode);  // magic byte leads the stream
+      queued_add(1);
+    }
     add_conn(c);
     return c;
   }
@@ -227,12 +617,31 @@ struct Transport {
     uint32_t len = (uint32_t)payload.size();
     uint8_t hdr[4] = {(uint8_t)(len >> 24), (uint8_t)(len >> 16),
                       (uint8_t)(len >> 8), (uint8_t)len};
-    c->wbuf.insert(c->wbuf.end(), hdr, hdr + 4);
-    c->wbuf.insert(c->wbuf.end(), payload.begin(), payload.end());
+    bump(ST_FRAMES_SENT);
+    if (c->ssl != nullptr) {
+      if (c->handshaking) {
+        c->plain_pending.insert(c->plain_pending.end(), hdr, hdr + 4);
+        c->plain_pending.insert(c->plain_pending.end(), payload.begin(),
+                                payload.end());
+        queued_add(4 + payload.size());
+      } else {
+        if (!tls_write_plain(c, hdr, 4) ||
+            !tls_write_plain(c, payload.data(), payload.size())) {
+          drop_conn(c, true);
+          return;
+        }
+        tls_drain_wbio(c);
+      }
+    } else {
+      c->wbuf.insert(c->wbuf.end(), hdr, hdr + 4);
+      c->wbuf.insert(c->wbuf.end(), payload.begin(), payload.end());
+      queued_add(4 + payload.size());
+    }
     arm(c);
   }
 
   void handle_cmd(Cmd &cmd) {
+    queued_sub(cmd.data.size());
     switch (cmd.type) {
       case CMD_DGRAM: {
         sockaddr_in sa{};
@@ -241,6 +650,8 @@ struct Transport {
         if (inet_pton(AF_INET, cmd.ip.c_str(), &sa.sin_addr) == 1) {
           sendto(udp_fd, cmd.data.data(), cmd.data.size(), 0, (sockaddr *)&sa,
                  sizeof(sa));
+          bump(ST_DGRAM_SENT);
+          bump(ST_DGRAM_BYTES_SENT, cmd.data.size());
         }
         break;
       }
@@ -282,6 +693,22 @@ struct Transport {
         if (it != conns.end()) drop_conn(it->second, false);
         break;
       }
+      case CMD_FLUSH: {
+        FlushWaiter w;
+        w.token = cmd.conn_id;
+        for (auto &kv : conns) {
+          if (conn_pending(kv.second)) w.conns.insert(kv.first);
+        }
+        if (w.conns.empty()) {
+          Event ev{};
+          ev.type = EV_FLUSHED;
+          ev.conn_id = w.token;
+          push_event(std::move(ev));
+        } else {
+          flush_waiters.push_back(std::move(w));
+        }
+        break;
+      }
       default:
         break;
     }
@@ -297,23 +724,32 @@ struct Transport {
         return;
       }
       c->connecting = false;
-      double ms = std::chrono::duration<double, std::milli>(
-                      std::chrono::steady_clock::now() - c->t0)
-                      .count();
-      Event rtt{};
-      rtt.type = EV_RTT;
-      rtt.conn_id = c->id;
-      rtt.ip = c->ip;
-      rtt.port = c->port;
-      rtt.rtt_ms = ms;
-      push_event(std::move(rtt));
-      if (c->mode == 'B') {
-        Event ev{};
-        ev.type = EV_BI_CONNECTED;
-        ev.conn_id = c->id;
-        ev.ip = c->ip;
-        ev.port = c->port;
-        push_event(std::move(ev));
+      bump(ST_CONNS_CONNECTED);
+      if (c->ssl != nullptr) {
+        // TLS: RTT + BI_CONNECTED fire when the handshake completes
+        if (!tls_handshake_step(c)) {
+          drop_conn(c, true);
+          return;
+        }
+      } else {
+        double ms = std::chrono::duration<double, std::milli>(
+                        std::chrono::steady_clock::now() - c->t0)
+                        .count();
+        Event rtt{};
+        rtt.type = EV_RTT;
+        rtt.conn_id = c->id;
+        rtt.ip = c->ip;
+        rtt.port = c->port;
+        rtt.rtt_ms = ms;
+        push_event(std::move(rtt));
+        if (c->mode == 'B') {
+          Event ev{};
+          ev.type = EV_BI_CONNECTED;
+          ev.conn_id = c->id;
+          ev.ip = c->ip;
+          ev.port = c->port;
+          push_event(std::move(ev));
+        }
       }
     }
     while (!c->wbuf.empty()) {
@@ -327,6 +763,9 @@ struct Transport {
       ssize_t n = send(c->fd, tmp, run, MSG_NOSIGNAL);
       if (n > 0) {
         c->wbuf.erase(c->wbuf.begin(), c->wbuf.begin() + n);
+        queued_sub((uint64_t)n);
+        bump(ST_STREAM_BYTES_SENT, (uint64_t)n);
+        c->last_progress = std::chrono::steady_clock::now();
       } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
         break;
       } else {
@@ -335,6 +774,7 @@ struct Transport {
       }
     }
     arm(c);
+    if (!conn_pending(c)) flush_waiters_conn_done(c->id);
   }
 
   void parse_frames(Conn *c) {
@@ -375,6 +815,7 @@ struct Transport {
       ev.data.assign(c->rbuf.begin() + off + 4,
                      c->rbuf.begin() + off + 4 + len);
       push_event(std::move(ev));
+      bump(ST_FRAMES_RECV);
       off += 4 + len;
     }
     if (off > 0) c->rbuf.erase(c->rbuf.begin(), c->rbuf.begin() + off);
@@ -382,17 +823,24 @@ struct Transport {
 
   void handle_read(Conn *c) {
     uint8_t buf[kReadChunk];
+    bool eof = false;
     while (true) {
       ssize_t n = recv(c->fd, buf, sizeof(buf), 0);
       if (n > 0) {
-        c->rbuf.insert(c->rbuf.end(), buf, buf + n);
-        if (c->rbuf.size() > kMaxFrame + 5) {
-          drop_conn(c, true);  // runaway unframed sender
-          return;
+        bump(ST_STREAM_BYTES_RECV, (uint64_t)n);
+        c->last_progress = std::chrono::steady_clock::now();
+        if (c->ssl != nullptr) {
+          ssl_api->BIO_write(c->rbio, buf, (int)n);
+        } else {
+          c->rbuf.insert(c->rbuf.end(), buf, buf + n);
+          if (c->rbuf.size() > kMaxFrame + 5) {
+            drop_conn(c, true);  // runaway unframed sender
+            return;
+          }
         }
       } else if (n == 0) {
-        drop_conn(c, true);
-        return;
+        eof = true;
+        break;
       } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
         break;
       } else {
@@ -400,7 +848,30 @@ struct Transport {
         return;
       }
     }
-    parse_frames(c);
+    if (c->ssl != nullptr) {
+      if (!tls_handshake_step(c)) {
+        drop_conn(c, true);
+        return;
+      }
+      if (!c->handshaking && !tls_read_plain(c)) {
+        drop_conn(c, true);
+        return;
+      }
+      tls_drain_wbio(c);
+      arm(c);
+      int64_t id = c->id;
+      parse_frames(c);  // may drop c
+      auto it = conns.find(id);
+      if (it == conns.end()) return;
+      c = it->second;
+    } else {
+      int64_t id = c->id;
+      parse_frames(c);
+      auto it = conns.find(id);
+      if (it == conns.end()) return;
+      c = it->second;
+    }
+    if (eof) drop_conn(c, true);
   }
 
   void accept_loop() {
@@ -419,7 +890,13 @@ struct Transport {
       c->id = next_id.fetch_add(1);
       c->ip = ipbuf;
       c->port = ntohs(sa.sin_port);
+      if (server_ctx != nullptr && !tls_attach(c, true)) {
+        close(fd);
+        delete c;
+        continue;
+      }
       add_conn(c);
+      bump(ST_CONNS_ACCEPTED);
     }
   }
 
@@ -439,13 +916,40 @@ struct Transport {
       ev.port = ntohs(sa.sin_port);
       ev.data.assign(buf, buf + n);
       push_event(std::move(ev));
+      bump(ST_DGRAM_RECV);
+      bump(ST_DGRAM_BYTES_RECV, (uint64_t)n);
     }
+  }
+
+  // Drop connections that have owed work (connect, handshake, queued
+  // writes) without forward progress for stall_timeout_ms.  Idle cached
+  // connections with empty buffers are never touched.
+  void reap_stalled() {
+    auto now = std::chrono::steady_clock::now();
+    std::vector<Conn *> dead;
+    for (auto &kv : conns) {
+      Conn *c = kv.second;
+      if (!c->connecting && !c->handshaking && c->wbuf.empty()) continue;
+      auto age = std::chrono::duration_cast<std::chrono::milliseconds>(
+                     now - c->last_progress)
+                     .count();
+      if (age > stall_timeout_ms) dead.push_back(c);
+    }
+    for (Conn *c : dead) drop_conn(c, true);
   }
 
   void run() {
     epoll_event evs[64];
+    auto last_reap = std::chrono::steady_clock::now();
     while (running.load()) {
       int n = epoll_wait(epoll_fd, evs, 64, 500);
+      auto now = std::chrono::steady_clock::now();
+      if (std::chrono::duration_cast<std::chrono::milliseconds>(now -
+                                                                last_reap)
+              .count() >= 500) {
+        last_reap = now;
+        reap_stalled();
+      }
       for (int i = 0; i < n; i++) {
         int fd = evs[i].data.fd;
         if (fd == wake_fd) {
@@ -495,10 +999,29 @@ struct Transport {
 
 extern "C" {
 
+// tls_on enables TLS 1.3 on the stream channels (cert_file/key_file are
+// then required — a TLS transport must never silently serve plaintext).
+// Passed-in udp_fd/tcp_fd are owned by the transport from this call on:
+// every failure path closes them (the Python side dups before handing
+// off so its sockets survive a failed create).  Returns nullptr on bind
+// or TLS setup failure (err_buf carries the reason).
 Transport *corro_tp_create(const char *host, int port, int udp_fd,
-                           int tcp_fd) {
+                           int tcp_fd, int tls_on, const char *cert_file,
+                           const char *key_file, const char *ca_file,
+                           int mtls, int insecure,
+                           const char *client_cert_file,
+                           const char *client_key_file,
+                           int stall_timeout_ms, char *err_buf,
+                           int err_cap) {
+  auto fail = [&](const std::string &msg) {
+    if (err_buf != nullptr && err_cap > 0)
+      snprintf(err_buf, (size_t)err_cap, "%s", msg.c_str());
+  };
   Transport *tp = new Transport();
   tp->host = host;
+  if (stall_timeout_ms > 0) tp->stall_timeout_ms = stall_timeout_ms;
+  // adopt/bind the sockets FIRST so ~Transport closes them on any
+  // failure below
   if (udp_fd >= 0 && tcp_fd >= 0) {
     tp->udp_fd = udp_fd;
     tp->listen_fd = tcp_fd;
@@ -516,6 +1039,7 @@ Transport *corro_tp_create(const char *host, int port, int udp_fd,
     sa.sin_port = htons((uint16_t)port);
     if (inet_pton(AF_INET, host, &sa.sin_addr) != 1 ||
         bind(tp->udp_fd, (sockaddr *)&sa, sizeof(sa)) != 0) {
+      fail("failed to bind UDP socket");
       delete tp;
       return nullptr;
     }
@@ -524,8 +1048,80 @@ Transport *corro_tp_create(const char *host, int port, int udp_fd,
     tp->port = ntohs(sa.sin_port);
     if (bind(tp->listen_fd, (sockaddr *)&sa, sizeof(sa)) != 0 ||
         listen(tp->listen_fd, 128) != 0) {
+      fail("failed to bind TCP socket");
       delete tp;
       return nullptr;
+    }
+  }
+  if (tls_on != 0) {
+    std::string err;
+    tp->ssl_api = load_ssl_api(&err);
+    if (tp->ssl_api == nullptr) {
+      fail("TLS requested but " + err);
+      delete tp;
+      return nullptr;
+    }
+    SslApi *api = tp->ssl_api;
+    tp->tls_insecure = insecure != 0;
+    if (cert_file == nullptr || cert_file[0] == '\0' ||
+        key_file == nullptr || key_file[0] == '\0') {
+      fail("TLS requires cert_file and key_file");
+      delete tp;
+      return nullptr;
+    }
+    {
+      tp->server_ctx = api->SSL_CTX_new(api->TLS_server_method());
+      api->SSL_CTX_ctrl(tp->server_ctx, kSslCtrlSetMinProtoVersion,
+                        kTls13Version, nullptr);
+      if (api->SSL_CTX_use_certificate_chain_file(tp->server_ctx,
+                                                  cert_file) != 1 ||
+          api->SSL_CTX_use_PrivateKey_file(tp->server_ctx, key_file,
+                                           kSslFiletypePem) != 1) {
+        fail(std::string("failed to load server cert/key: ") + cert_file);
+        delete tp;
+        return nullptr;
+      }
+      if (mtls != 0) {
+        if (ca_file == nullptr || ca_file[0] == '\0' ||
+            api->SSL_CTX_load_verify_locations(tp->server_ctx, ca_file,
+                                               nullptr) != 1) {
+          fail("mTLS requires a loadable client CA file");
+          delete tp;
+          return nullptr;
+        }
+        api->SSL_CTX_set_verify(
+            tp->server_ctx, kSslVerifyPeer | kSslVerifyFailIfNoPeerCert,
+            nullptr);
+      }
+    }
+    tp->client_ctx = api->SSL_CTX_new(api->TLS_client_method());
+    api->SSL_CTX_ctrl(tp->client_ctx, kSslCtrlSetMinProtoVersion,
+                      kTls13Version, nullptr);
+    if (insecure != 0) {
+      api->SSL_CTX_set_verify(tp->client_ctx, kSslVerifyNone, nullptr);
+    } else {
+      if (ca_file != nullptr && ca_file[0] != '\0') {
+        if (api->SSL_CTX_load_verify_locations(tp->client_ctx, ca_file,
+                                               nullptr) != 1) {
+          fail(std::string("failed to load CA file: ") + ca_file);
+          delete tp;
+          return nullptr;
+        }
+      } else {
+        api->SSL_CTX_set_default_verify_paths(tp->client_ctx);
+      }
+      api->SSL_CTX_set_verify(tp->client_ctx, kSslVerifyPeer, nullptr);
+    }
+    if (client_cert_file != nullptr && client_cert_file[0] != '\0') {
+      if (api->SSL_CTX_use_certificate_chain_file(tp->client_ctx,
+                                                  client_cert_file) != 1 ||
+          api->SSL_CTX_use_PrivateKey_file(tp->client_ctx, client_key_file,
+                                           kSslFiletypePem) != 1) {
+        fail(std::string("failed to load client cert/key: ") +
+             client_cert_file);
+        delete tp;
+        return nullptr;
+      }
     }
   }
   set_nonblock(tp->udp_fd);
@@ -597,6 +1193,28 @@ void corro_tp_bi_close(Transport *tp, int64_t conn_id) {
   cmd.type = CMD_BI_CLOSE;
   cmd.conn_id = conn_id;
   tp->enqueue_cmd(std::move(cmd));
+}
+
+// Request a flush barrier: EV_FLUSHED with this token fires once every
+// byte enqueued before this call has been handed to the kernel.
+void corro_tp_flush(Transport *tp, int64_t token) {
+  Cmd cmd{};
+  cmd.type = CMD_FLUSH;
+  cmd.conn_id = token;
+  tp->enqueue_cmd(std::move(cmd));
+}
+
+// Total bytes sitting in the command queue, TLS pending buffers, and
+// socket write buffers — the backpressure signal.
+uint64_t corro_tp_queued_bytes(Transport *tp) {
+  return tp->stats[ST_QUEUED_BYTES].load(std::memory_order_relaxed);
+}
+
+// Fills out[0..n) with the ST_* counters (see StatSlot).
+void corro_tp_stats(Transport *tp, uint64_t *out, int n) {
+  for (int i = 0; i < n && i < ST_COUNT; i++) {
+    out[i] = tp->stats[i].load(std::memory_order_relaxed);
+  }
 }
 
 // Event drain: returns 1 and fills the out-params when an event was

@@ -116,8 +116,10 @@ def test_bi_session_echo():
     run(main())
 
 
-def test_native_rejects_tls():
-    with pytest.raises(ValueError, match="plaintext-only"):
+def test_native_rejects_python_ssl_contexts():
+    """TLS reaches the native core as a GossipTlsConfig (file paths);
+    python SSLContext objects cannot cross the C boundary."""
+    with pytest.raises(ValueError, match="GossipTlsConfig"):
         NativeTransport(ssl_server=object())
 
 
@@ -208,5 +210,154 @@ def test_cluster_on_native_transport():
             await n3.stop()
             await n2.stop()
             await n1.stop()
+
+    run(main())
+
+
+def test_flush_barrier_completes_sends():
+    """flush() resolves only after every previously enqueued frame has
+    been handed to the kernel — by then loopback delivery is observable
+    after a short drain of the receiver's event queue (the send-
+    completion barrier the round-paced fidelity harness relies on)."""
+
+    async def main():
+        a, _ = await _mk(NativeTransport)
+        b, received = await _mk(NativeTransport)
+        try:
+            n_frames = 50
+            payload = b"y" * 32_000
+            for _ in range(n_frames):
+                await a.send_uni(("127.0.0.1", b.port), payload)
+            await a.flush()
+            # all bytes left a's queues: nothing pending on the sender
+            assert a.queued_bytes() == 0
+            assert a.stats()["frames_sent"] == n_frames
+            await _wait(lambda: len(received["uni"]) == n_frames)
+        finally:
+            await a.stop()
+            await b.stop()
+
+    run(main())
+
+
+def test_flush_no_pending_is_immediate():
+    async def main():
+        a, _ = await _mk(NativeTransport)
+        try:
+            await asyncio.wait_for(a.flush(), 2.0)  # nothing queued
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_flush_with_dead_peer_still_resolves():
+    """A connection that dies with bytes queued must not wedge the
+    barrier: drop removes it from every waiter."""
+
+    async def main():
+        a, _ = await _mk(NativeTransport)
+        b, _ = await _mk(NativeTransport)
+        port = b.port
+        try:
+            await a.send_uni(("127.0.0.1", port), b"first")
+            await a.flush()
+            await b.stop()  # peer goes away; cached conn goes stale
+            await a.send_uni(("127.0.0.1", port), b"into the void")
+            await asyncio.wait_for(a.flush(), 10.0)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_queued_bytes_backpressure_counter():
+    """queued_bytes rises while frames sit in the queues and returns to
+    zero after a flush (the bounded-queue signal)."""
+
+    async def main():
+        a, _ = await _mk(NativeTransport)
+        b, received = await _mk(NativeTransport)
+        try:
+            for _ in range(20):
+                await a.send_uni(("127.0.0.1", b.port), b"z" * 60_000)
+            await a.flush()
+            assert a.queued_bytes() == 0
+            await _wait(lambda: len(received["uni"]) == 20)
+            stats = a.stats()
+            assert stats["stream_bytes_sent"] >= 20 * 60_000
+            assert b.stats()["frames_recv"] == 20
+        finally:
+            await a.stop()
+            await b.stop()
+
+    run(main())
+
+
+def test_stats_counters_move():
+    async def main():
+        a, _ = await _mk(NativeTransport)
+        b, received = await _mk(NativeTransport)
+        try:
+            a.send_datagram(("127.0.0.1", b.port), b"probe")
+            await _wait(lambda: len(received["dgrams"]) == 1)
+            fs = await a.open_bi(("127.0.0.1", b.port))
+            await fs.send(b"ping")
+            assert await fs.recv(timeout=5.0) == b"echo:ping"
+            fs.close()
+            sa, sb = a.stats(), b.stats()
+            assert sa["datagrams_sent"] == 1
+            assert sb["datagrams_recv"] == 1
+            assert sa["conns_connected"] >= 1
+            assert sb["conns_accepted"] >= 1
+            assert sa["frames_sent"] >= 1 and sa["frames_recv"] >= 1
+        finally:
+            await a.stop()
+            await b.stop()
+
+    run(main())
+
+
+def test_stalled_peer_reaped_and_flush_unblocked():
+    """A peer that accepts but never reads cannot wedge the transport:
+    once the socket buffers fill, the stall reaper drops the connection
+    within stall_timeout_ms, queued bytes release, and flush resolves —
+    so one dead peer never head-of-line-blocks sends to healthy peers."""
+    import socket as socketmod
+
+    async def main():
+        srv = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        srv.setblocking(False)
+        port = srv.getsockname()[1]
+
+        a, _ = await _mk(NativeTransport, stall_timeout_ms=1500)
+        accepted = []
+
+        async def accept_never_read():
+            loop = asyncio.get_running_loop()
+            conn, _ = await loop.sock_accept(srv)
+            accepted.append(conn)  # never read from it
+
+        task = asyncio.ensure_future(accept_never_read())
+        try:
+            # pump until both kernel buffers + the conn's wbuf are full
+            for _ in range(400):
+                await a.send_uni(("127.0.0.1", port), b"s" * 64_000)
+            await task
+            assert a.queued_bytes() > 0  # kernel refused some of it
+            t0 = asyncio.get_running_loop().time()
+            await asyncio.wait_for(a.flush(), 10.0)
+            took = asyncio.get_running_loop().time() - t0
+            assert a.queued_bytes() == 0
+            assert a.stats()["conns_dropped"] >= 1
+            assert took < 8.0, took
+        finally:
+            task.cancel()
+            for conn in accepted:
+                conn.close()
+            srv.close()
+            await a.stop()
 
     run(main())

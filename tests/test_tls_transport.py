@@ -69,11 +69,14 @@ def tls_config(certs, mtls=False, cert="server_cert", key="server_key"):
     )
 
 
-async def boot_tls(certs, bootstrap=(), mtls=False, **tls_overrides):
+async def boot_tls(
+    certs, bootstrap=(), mtls=False, impl="native", **tls_overrides
+):
     cfg = Config()
     cfg.db.path = ":memory:"
     cfg.gossip.bootstrap = list(bootstrap)
     cfg.gossip.plaintext = False
+    cfg.gossip.transport_impl = impl
     cfg.gossip.tls = tls_config(certs, mtls=mtls)
     for k, v in tls_overrides.items():
         setattr(cfg.gossip.tls, k, v)
@@ -103,14 +106,30 @@ async def replicates(n1, n2, timeout=30.0):
         await asyncio.sleep(0.2)
 
 
-def test_tls_cluster_replicates(certs):
+@pytest.mark.parametrize(
+    "impls",
+    [
+        ("python", "python"),
+        ("native", "native"),
+        ("native", "python"),
+        ("python", "native"),
+    ],
+    ids=lambda p: "->".join(p),
+)
+def test_tls_cluster_replicates(certs, impls):
+    """TLS gossip end-to-end on both transport implementations and the
+    mixed pairs (the wire protocol inside TLS is shared)."""
+
     async def main():
-        n1 = await boot_tls(certs)
+        n1 = await boot_tls(certs, impl=impls[0])
         n2 = await boot_tls(
-            certs, bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"]
+            certs,
+            bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"],
+            impl=impls[1],
         )
         try:
-            assert n1.transport.ssl_server is not None
+            if impls[0] == "python":
+                assert n1.transport.ssl_server is not None
             assert await replicates(n1, n2)
         finally:
             await n2.stop()
@@ -119,13 +138,26 @@ def test_tls_cluster_replicates(certs):
     run(main())
 
 
-def test_mtls_cluster_replicates(certs):
+@pytest.mark.parametrize(
+    "impls",
+    [
+        ("python", "python"),
+        ("native", "native"),
+        ("native", "python"),
+        ("python", "native"),
+    ],
+    ids=lambda p: "->".join(p),
+)
+def test_mtls_cluster_replicates(certs, impls):
     """Full mutual TLS (ref: test_mutual_tls, peer.rs:1773-1881)."""
 
     async def main():
-        n1 = await boot_tls(certs, mtls=True)
+        n1 = await boot_tls(certs, mtls=True, impl=impls[0])
         n2 = await boot_tls(
-            certs, bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"], mtls=True
+            certs,
+            bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"],
+            mtls=True,
+            impl=impls[1],
         )
         try:
             assert await replicates(n1, n2)
@@ -136,9 +168,10 @@ def test_mtls_cluster_replicates(certs):
     run(main())
 
 
-def test_plaintext_client_rejected_by_tls_node(certs):
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_plaintext_client_rejected_by_tls_node(certs, impl):
     async def main():
-        n1 = await boot_tls(certs)
+        n1 = await boot_tls(certs, impl=impl)
         try:
             reader, writer = await asyncio.open_connection(
                 "127.0.0.1", n1.gossip_addr[1]
@@ -155,7 +188,8 @@ def test_plaintext_client_rejected_by_tls_node(certs):
     run(main())
 
 
-def test_mtls_rejects_untrusted_node(certs, tmp_path):
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_mtls_rejects_untrusted_node(certs, tmp_path, impl):
     """Under mTLS a node whose certs come from an untrusted CA can move
     data in NEITHER direction: its outbound streams fail n1's client-cert
     check, and n1's streams to it fail server verification.  (Without
@@ -174,11 +208,12 @@ def test_mtls_rejects_untrusted_node(certs, tmp_path):
         )
         tlsmod.write_pair(bad_client_cert, bad_client_key, *bad_client)
 
-        n1 = await boot_tls(certs, mtls=True)
+        n1 = await boot_tls(certs, mtls=True, impl=impl)
         n2 = await boot_tls(
             certs,
             bootstrap=[f"127.0.0.1:{n1.gossip_addr[1]}"],
             mtls=True,
+            impl=impl,
             cert_file=certs["bad_cert"],
             key_file=certs["bad_key"],
             client_cert_file=bad_client[0],
@@ -193,16 +228,72 @@ def test_mtls_rejects_untrusted_node(certs, tmp_path):
     run(main())
 
 
-def test_tls_config_falls_back_to_python_transport(certs):
-    """A TLS-configured node must run the python transport even when
-    transport_impl is 'native' (the native core is plaintext-only)."""
-    from corrosion_tpu.transport.net import Transport
+def test_tls_runs_on_native_transport(certs):
+    """A TLS-configured node keeps the native (C++) transport — the
+    operators no longer choose between the fast core and encryption
+    (round-3 verdict item 1)."""
+    from corrosion_tpu.transport.native import NativeTransport
 
     async def main():
-        node = await boot_tls(certs)
+        node = await boot_tls(certs, impl="native")
         try:
-            assert type(node.transport) is Transport
+            assert type(node.transport) is NativeTransport
+            assert node.transport.tls is not None
+            stats = node.transport.stats()
+            assert "handshakes_ok" in stats
         finally:
             await node.stop()
+
+    run(main())
+
+
+def test_native_tls_untrusted_server_rejected(certs):
+    """A native TLS client must refuse a server whose cert chain is
+    signed by an unknown CA (server verification, peer.rs:226-258)."""
+    from corrosion_tpu.transport.native import NativeTransport
+
+    async def main():
+        bad = await boot_tls(
+            certs,
+            impl="native",
+            cert_file=certs["bad_cert"],
+            key_file=certs["bad_key"],
+        )
+        client = NativeTransport(tls=tls_config(certs))
+        await client.start()
+        try:
+            with pytest.raises(ConnectionError):
+                await client.open_bi(("127.0.0.1", bad.gossip_addr[1]))
+            assert client.stats()["handshakes_failed"] >= 1
+        finally:
+            await client.stop()
+            await bad.stop()
+
+    run(main())
+
+
+def test_native_tls_insecure_mode(certs):
+    """insecure=True skips server verification (the reference's insecure
+    mode) — an untrusted server cert is accepted."""
+    from corrosion_tpu.transport.native import NativeTransport
+
+    async def main():
+        bad = await boot_tls(
+            certs,
+            impl="native",
+            cert_file=certs["bad_cert"],
+            key_file=certs["bad_key"],
+        )
+        client = NativeTransport(
+            tls=tls_config(certs, cert="bad_cert", key="bad_key")
+        )
+        client.tls.insecure = True
+        await client.start()
+        try:
+            fs = await client.open_bi(("127.0.0.1", bad.gossip_addr[1]))
+            fs.close()
+        finally:
+            await client.stop()
+            await bad.stop()
 
     run(main())

@@ -43,6 +43,10 @@ from .bookkeeping import (
 )
 
 CHANGE_COLS = '"table", pk, cid, val, col_version, db_version, seq, site_id, cl'
+# Changesets at least this large are merged with one executemany + a single
+# rows_impacted probe (see process_complete_version); smaller ones keep the
+# reference's exact per-row impact tracking (util.rs:1552-1591).
+BULK_APPLY_THRESHOLD = 64
 
 
 def store_empty_changeset(
@@ -177,25 +181,58 @@ def process_complete_version(
     ins = (
         f"INSERT INTO crsql_changes ({CHANGE_COLS}) VALUES (?,?,?,?,?,?,?,?,?)"
     )
-    for ch in changeset.changes:
-        conn.execute(
+    if len(changeset.changes) >= BULK_APPLY_THRESHOLD:
+        # Large changesets (sync catch-up) skip the per-row impact probe:
+        # one executemany + one rows_impacted read instead of 2·N Python
+        # round-trips — the difference between the 65k-row catch-up
+        # holding or missing the reference's ~22 s envelope.  Trade-off:
+        # when only SOME rows win their LWW merge, ``impactful`` is the
+        # whole changeset instead of the winning subset — an
+        # over-approximation that only widens the subscription-matcher
+        # candidate set (matchers re-query and diff per candidate PK, so
+        # no spurious change events; ref keeps the exact subset,
+        # util.rs:1552-1591, which small changesets still do below).
+        conn.executemany(
             ins,
             (
-                ch.table,
-                ch.pk,
-                ch.cid,
-                ch.val,
-                ch.col_version,
-                ch.db_version,
-                ch.seq,
-                ch.site_id,
-                ch.cl,
+                (
+                    ch.table,
+                    ch.pk,
+                    ch.cid,
+                    ch.val,
+                    ch.col_version,
+                    ch.db_version,
+                    ch.seq,
+                    ch.site_id,
+                    ch.cl,
+                )
+                for ch in changeset.changes
             ),
         )
         impacted = conn.execute("SELECT crsql_rows_impacted()").fetchone()[0]
         if impacted > last_impacted:
-            impactful.append(ch)
+            impactful = list(changeset.changes)
         last_impacted = impacted
+    else:
+        for ch in changeset.changes:
+            conn.execute(
+                ins,
+                (
+                    ch.table,
+                    ch.pk,
+                    ch.cid,
+                    ch.val,
+                    ch.col_version,
+                    ch.db_version,
+                    ch.seq,
+                    ch.site_id,
+                    ch.cl,
+                ),
+            )
+            impacted = conn.execute("SELECT crsql_rows_impacted()").fetchone()[0]
+            if impacted > last_impacted:
+                impactful.append(ch)
+            last_impacted = impacted
 
     if not impactful:
         return CLEARED, ChangesetEmpty(versions=changeset.versions, ts=changeset.ts)
@@ -227,9 +264,9 @@ def process_incomplete_version(
         "val, col_version, db_version, site_id, seq, cl, version) VALUES "
         "(?,?,?,?,?,?,?,?,?,?)"
     )
-    for ch in changeset.changes:
-        conn.execute(
-            ins,
+    conn.executemany(
+        ins,
+        (
             (
                 ch.table,
                 ch.pk,
@@ -241,8 +278,10 @@ def process_incomplete_version(
                 ch.seq,
                 ch.cl,
                 version,
-            ),
-        )
+            )
+            for ch in changeset.changes
+        ),
+    )
 
     # merge the covered seq range into __corro_seq_bookkeeping
     seqs = RangeSet()

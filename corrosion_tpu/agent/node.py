@@ -28,6 +28,7 @@ from ..types.broadcast import ChangeSource, ChangeV1
 from ..types.config import Config, parse_addr
 from ..types.members import Members
 from ..types.schema import apply_schema
+from ..utils.metrics import counter
 from .. import wire
 from .agent import Agent, AgentConfig
 from .handlers import ChangeIngest
@@ -79,6 +80,8 @@ class Node:
         self._tasks: List[asyncio.Task] = []
         self._subs_tmpdir = None  # TemporaryDirectory for :memory: nodes
         self._started = False
+        # virtual SWIM clock (perf.manual_swim round pacing)
+        self.swim_vnow = 0.0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -208,22 +211,23 @@ class Node:
             raise ValueError(
                 f"gossip.swim_impl must be 'native' or 'python', got {impl!r}"
             )
+        # manual_swim: the SWIM clock is virtual, epoch 0 (both cores take
+        # explicit `now` args; the harness advances it per round)
+        swim_now = 0.0 if self.config.perf.manual_swim else time.monotonic()
         if impl == "native":
             try:
                 from ..swim.native import NativeSwim, load as load_swim_lib
 
                 # the first call may invoke g++ — keep it off the event loop
                 await asyncio.to_thread(load_swim_lib)
-                self.swim = NativeSwim(
-                    identity, swim_config, now=time.monotonic()
-                )
+                self.swim = NativeSwim(identity, swim_config, now=swim_now)
             except (RuntimeError, OSError) as e:
                 logger.warning(
                     "native SWIM core unavailable (%s); using python core", e
                 )
-                self.swim = Swim(identity, swim_config, now=time.monotonic())
+                self.swim = Swim(identity, swim_config, now=swim_now)
         else:
-            self.swim = Swim(identity, swim_config, now=time.monotonic())
+            self.swim = Swim(identity, swim_config, now=swim_now)
         logger.debug("swim core: %s", type(self.swim).__name__)
         self.broadcast = BroadcastRuntime(
             self.transport,
@@ -302,7 +306,8 @@ class Node:
         if not self.config.perf.manual_pacing:
             self.broadcast.start()
         self.ingest.start()
-        self._tasks.append(asyncio.create_task(self._swim_loop()))
+        if not self.config.perf.manual_swim:
+            self._tasks.append(asyncio.create_task(self._swim_loop()))
         if not self.config.perf.manual_pacing:
             self._tasks.append(asyncio.create_task(self._sync_loop()))
         if self.config.perf.compact_interval > 0:
@@ -313,17 +318,24 @@ class Node:
         ):
             self._tasks.append(asyncio.create_task(self._wal_truncate_loop()))
         self._tasks.append(asyncio.create_task(self._persist_members_loop()))
-        self._tasks.append(asyncio.create_task(self._announce_loop()))
+        if not self.config.perf.manual_swim:
+            self._tasks.append(asyncio.create_task(self._announce_loop()))
         if self.config.telemetry.prometheus_addr:
             # gauges nothing will scrape aren't worth COUNT(*) scans
             self._tasks.append(asyncio.create_task(self._metrics_loop()))
+            self._tasks.append(
+                asyncio.create_task(self._runtime_metrics_loop())
+            )
         self._started = True
         return self
 
-    async def stop(self) -> None:
+    async def stop(self, crash: bool = False) -> None:
         """Graceful shutdown (ref: Tripwire poisoning + drain,
-        handlers.rs:70-77 + broadcast/mod.rs:323-372 leave_cluster)."""
-        if self.swim is not None:
+        handlers.rs:70-77 + broadcast/mod.rs:323-372 leave_cluster).
+        ``crash=True`` skips the SWIM leave broadcast — the node just
+        vanishes, so peers must DETECT the failure (probe → suspect →
+        down); the harness uses this to realize the sim's churn deaths."""
+        if self.swim is not None and not crash:
             self.swim.leave()
             await self._pump_swim()
         for t in self._tasks:
@@ -383,13 +395,31 @@ class Node:
             return
         # both cores validate + decode internally; malformed peer datagrams
         # are dropped there and never escape into the protocol callback
-        self.swim.handle_datagram(data, time.monotonic())
+        self.swim.handle_datagram(data, self._swim_now())
+
+    def _swim_now(self) -> float:
+        """SWIM clock: wall time, or the harness-advanced virtual time
+        under perf.manual_swim round pacing."""
+        if self.config.perf.manual_swim:
+            return self.swim_vnow
+        return time.monotonic()
+
+    async def swim_tick(self, vnow: float) -> None:
+        """Advance the SWIM core to virtual time ``vnow`` and pump its
+        outputs (perf.manual_swim round pacing; the harness calls this
+        several times per round so probe → ack → deadline cycles resolve
+        within the round)."""
+        assert self.swim is not None
+        self.swim_vnow = vnow
+        self.swim.tick(vnow)
+        await self._pump_swim()
 
     async def _pump_swim(self) -> None:
         assert self.swim is not None and self.transport is not None
         for dest, datagram in self.swim.take_datagrams():
             self.transport.send_datagram(dest, datagram)
         for actor, what in self.swim.take_events():
+            counter("corro.swim.events", what=what).inc()
             if what == "up":
                 if self.members.add_member(actor):
                     logger.debug("member up: %s", actor.id.as_simple())
@@ -573,8 +603,71 @@ class Node:
                 counts = await self.agent.pool.read_call(_table_counts)
                 for table, n in counts.items():
                     gauge("corro.db.table.rows", table=table, actor=me).set(n)
+                # transport counters (ref: the per-connection QUIC gauges,
+                # transport.rs:235-419) — both impls expose stats()
+                if self.transport is not None and hasattr(
+                    self.transport, "stats"
+                ):
+                    for name, v in self.transport.stats().items():
+                        gauge(f"corro.transport.{name}", actor=me).set(v)
+                # channel/queue depths (ref: the instrumented bounded
+                # channels, corro-types/src/channel.rs:53-95)
+                if self.ingest is not None:
+                    gauge("corro.ingest.queue.depth", actor=me).set(
+                        self.ingest.queue.qsize()
+                    )
+                    gauge("corro.ingest.apply.in_flight", actor=me).set(
+                        len(self.ingest._apply_tasks)
+                    )
+                if self.broadcast is not None:
+                    gauge("corro.broadcast.pending", actor=me).set(
+                        len(self.broadcast.pending)
+                    )
+                    gauge("corro.broadcast.queue.depth", actor=me).set(
+                        self.broadcast._queue.qsize()
+                    )
+                pool = self.agent.pool
+                for pri, label in ((0, "high"), (1, "normal"), (2, "low")):
+                    gauge(
+                        "corro.pool.write.queue.depth",
+                        actor=me, priority=label,
+                    ).set(len(pool._waiters[pri]))
+                gauge("corro.pool.read.available", actor=me).set(
+                    pool._read_pool.qsize()
+                )
+                if self.subs is not None:
+                    gauge("corro.subs.active", actor=me).set(
+                        len(self.subs.by_id)
+                    )
             except Exception:
                 logger.debug("metrics loop tick failed", exc_info=True)
+
+    async def _runtime_metrics_loop(self, interval: float = 1.0) -> None:
+        """asyncio runtime health (ref: tokio-metrics RuntimeMonitor ->
+        corro.tokio.* gauges, command/agent.rs:107-164): event-loop
+        scheduling lag, live task count, and default-executor pressure."""
+        from ..utils.metrics import gauge, histogram
+
+        me = self.agent.actor_id.as_simple()[:8]
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(interval)
+            lag = max(0.0, loop.time() - t0 - interval)
+            histogram("corro.runtime.loop.lag.seconds", actor=me).observe(lag)
+            gauge("corro.runtime.tasks.alive", actor=me).set(
+                len(asyncio.all_tasks(loop))
+            )
+            ex = getattr(loop, "_default_executor", None)
+            if ex is not None:
+                gauge("corro.runtime.executor.threads", actor=me).set(
+                    len(getattr(ex, "_threads", ()))
+                )
+                q = getattr(ex, "_work_queue", None)
+                if q is not None:
+                    gauge("corro.runtime.executor.queue.depth", actor=me).set(
+                        q.qsize()
+                    )
 
     async def _notify_subs(self, applied) -> None:
         """Remote-apply subscription notify (ref: util.rs:1380-1384)."""
@@ -593,6 +686,7 @@ class Node:
         change, cluster_id, _rebroadcast = data
         if cluster_id != self.config.gossip.cluster_id:
             return  # ref: uni.rs:63 cluster filter
+        counter("corro.broadcast.recv").inc()
         assert self.ingest is not None
         await self.ingest.submit(change, ChangeSource.BROADCAST)
 

@@ -63,8 +63,10 @@ async def cmd_agent(args) -> int:
     import socket as socketmod
 
     from ..agent.node import Node
+    from ..utils.log import setup_logging
 
     config = load_config(args)
+    setup_logging(config.log)
     gossip_socks = None
     inherited = os.environ.get("CORRO_GOSSIP_FDS")
     if inherited:

@@ -111,12 +111,65 @@ class DevCluster:
         self.config_tweaks = config_tweaks or {}
         self.seeded_actors = seeded_actors
         self.nodes: Dict[str, "Node"] = {}  # noqa: F821
+        self._ports: Dict[str, int] = {}
+
+    def _make_config(self, name: str):
+        from ..types.config import Config
+
+        cfg = Config()
+        cfg.db.path = ":memory:"
+        cfg.gossip.addr = f"127.0.0.1:{self._ports[name]}"
+        cfg.gossip.bootstrap = [
+            f"127.0.0.1:{self._ports[peer]}"
+            for peer in self.topology.edges[name]
+        ]
+        # fast timers for test clusters
+        cfg.gossip.probe_period = 0.3
+        cfg.gossip.probe_timeout = 0.15
+        cfg.gossip.suspicion_timeout = 1.0
+        cfg.perf.sync_interval_min = 0.3
+        cfg.perf.sync_interval_max = 1.0
+        for section, values in self.config_tweaks.items():
+            target = getattr(cfg, section)
+            for k, v in values.items():
+                setattr(target, k, v)
+        return cfg
+
+    def _actor_id(self, name: str):
+        if not self.seeded_actors:
+            return None
+        import hashlib
+
+        from ..types.actor import ActorId
+
+        return ActorId(hashlib.md5(name.encode()).digest())
+
+    async def _boot_node(self, name: str, socks: tuple) -> "Node":  # noqa: F821
+        from ..agent.node import Node
+        from ..types.schema import apply_schema
+
+        _, udp, tcp = socks
+        try:
+            node = await Node(
+                self._make_config(name),
+                gossip_socks=(udp, tcp),
+                actor_id=self._actor_id(name),
+            ).start()
+        except BaseException:
+            # the transport may not have taken ownership yet —
+            # close the handed-off pair so the fds don't leak
+            for s in (udp, tcp):
+                with contextlib.suppress(OSError):
+                    s.close()
+            raise
+        if self.schema:
+            await node.agent.pool.write_call(
+                lambda c, s=self.schema: apply_schema(c, s)
+            )
+        return node
 
     async def start(self) -> "DevCluster":
-        from ..agent.node import Node
         from ..transport.net import bind_port_pair
-        from ..types.config import Config
-        from ..types.schema import apply_schema
 
         # pre-assign every node's gossip port so bootstrap lists are
         # complete regardless of start order (the reference assigns all
@@ -125,53 +178,13 @@ class DevCluster:
         # probe-then-bind race can steal a port; leaves still start first
         # so responders are listening before initiators join
         socks = {name: bind_port_pair() for name in self.topology.nodes}
-        ports = {name: s[0] for name, s in socks.items()}
+        self._ports = {name: s[0] for name, s in socks.items()}
         order = self.topology.leaves() + self.topology.initiators()
         try:
             for name in order:
-                cfg = Config()
-                cfg.db.path = ":memory:"
-                cfg.gossip.addr = f"127.0.0.1:{ports[name]}"
-                cfg.gossip.bootstrap = [
-                    f"127.0.0.1:{ports[peer]}"
-                    for peer in self.topology.edges[name]
-                ]
-                # fast timers for test clusters
-                cfg.gossip.probe_period = 0.3
-                cfg.gossip.probe_timeout = 0.15
-                cfg.gossip.suspicion_timeout = 1.0
-                cfg.perf.sync_interval_min = 0.3
-                cfg.perf.sync_interval_max = 1.0
-                for section, values in self.config_tweaks.items():
-                    target = getattr(cfg, section)
-                    for k, v in values.items():
-                        setattr(target, k, v)
-                actor_id = None
-                if self.seeded_actors:
-                    import hashlib
-
-                    from ..types.actor import ActorId
-
-                    actor_id = ActorId(
-                        hashlib.md5(name.encode()).digest()
-                    )
-                _, udp, tcp = socks.pop(name)
-                try:
-                    node = await Node(
-                        cfg, gossip_socks=(udp, tcp), actor_id=actor_id
-                    ).start()
-                except BaseException:
-                    # the transport may not have taken ownership yet —
-                    # close the handed-off pair so the fds don't leak
-                    for s in (udp, tcp):
-                        with contextlib.suppress(OSError):
-                            s.close()
-                    raise
-                if self.schema:
-                    await node.agent.pool.write_call(
-                        lambda c, s=self.schema: apply_schema(c, s)
-                    )
-                self.nodes[name] = node
+                self.nodes[name] = await self._boot_node(
+                    name, socks.pop(name)
+                )
         finally:
             for _, udp, tcp in socks.values():  # nodes that never started
                 udp.close()
@@ -220,6 +233,107 @@ class DevCluster:
                 )
             await asyncio.sleep(interval)
 
+    # -- churn (node kill/restart, perf.manual_swim round pacing) ---------
+
+    async def kill(self, name: str) -> None:
+        """Crash-stop a node (no SWIM leave): it simply vanishes, and the
+        cluster must DETECT the death through probe → suspect → down —
+        the harness realization of the sim's churn deaths (sim/model.py
+        step 6).  The port stays reserved in ``self._ports`` for
+        :meth:`restart`."""
+        node = self.nodes.pop(name)
+        await node.stop(crash=True)
+
+    async def restart(self, name: str) -> "Node":  # noqa: F821
+        """Boot a replacement node on the killed node's address: same
+        seeded actor id, FRESH state (the Fly.io replacement-node
+        pattern the sim's churn step models — it re-registers only its
+        own local writes; the caller replays those).  The node's clock
+        allocates a new identity timestamp, so peers accept the rejoin
+        as a renewed identity (ref: Identity::renew, actor.rs:199-210)
+        even over SUSPECT/DOWN entries for the old incarnation."""
+        from ..transport.net import bind_port_pair
+
+        socks = bind_port_pair(port=self._ports[name])
+        node = await self._boot_node(name, socks)
+        self.nodes[name] = node
+        return node
+
+    async def announce_all(self, node: "Node") -> None:  # noqa: F821
+        """A restarted node announces itself to every cluster address
+        (sim: restart announce reaches every reachable view in its
+        round); peers respond with membership feeds, so the node's own
+        view converges to the cluster's in the same exchange."""
+        for name, port in sorted(self._ports.items()):
+            addr = ("127.0.0.1", port)
+            if addr != node.gossip_addr:
+                node.swim.announce(addr)
+        await node._pump_swim()
+        await self._pump_datagrams()
+
+    def seed_full_membership(self, now: float = 0.0) -> None:
+        """Install complete ALIVE membership in every node's SWIM core
+        and member registry (the sim starts from a fully-known cluster;
+        python SWIM core only — the churn fidelity experiment pins
+        ``swim_impl: python`` for seeded-rng reproducibility)."""
+        from ..swim.core import ALIVE, MemberEntry
+
+        identities = {
+            name: node.swim.identity for name, node in self.nodes.items()
+        }
+        for node in self.nodes.values():
+            for other in identities.values():
+                if other.id == node.swim.identity.id:
+                    continue
+                node.swim.members[other.id] = MemberEntry(
+                    actor=other,
+                    state=ALIVE,
+                    incarnation=0,
+                    state_since=now,
+                )
+                node.members.add_member(other)
+
+    async def _pump_datagrams(self, cycles: int = 3) -> None:
+        """Drain multi-hop SWIM exchanges: each cycle flushes every
+        node's queued sends into the kernel, lets loopback deliver them
+        (handlers run on receipt), then pumps the responses they queued.
+        Three cycles cover the longest chain (ping_req → fwd_ping →
+        ack)."""
+        for _ in range(cycles):
+            live = list(self.nodes.values())
+            await asyncio.gather(
+                *(n.transport.flush() for n in live),
+                return_exceptions=True,
+            )
+            await asyncio.sleep(0.02)
+            for node in live:
+                with contextlib.suppress(Exception):
+                    await node._pump_swim()
+
+    async def swim_phase(self, r: int, probe_timeout: float = 0.3) -> None:
+        """One round-paced SWIM probe round at virtual time ``r`` (one
+        probe period per round, the sim's step-2 abstraction).  Three
+        sub-ticks let the full failure-detection cycle resolve WITHIN
+        the round: probes go out at +0.0; direct-ack deadlines pass at
+        +probe_timeout+ε (indirect probes go out); indirect deadlines
+        pass at +2·probe_timeout+ε (unreachable targets are marked
+        SUSPECT this round).  Requires nodes started with
+        ``perf.manual_swim`` and gossip.probe_{period,timeout} = (1.0,
+        ``probe_timeout``); suspicion expiry then runs on round
+        boundaries when gossip.suspicion_timeout = suspicion_rounds −
+        0.7."""
+        for sub in (0.0, probe_timeout + 0.05, 2 * probe_timeout + 0.1):
+            vnow = float(r) + sub
+            live = list(self.nodes.values())
+            # tick everyone BEFORE any pump: all probe draws see the
+            # pre-round views, like the sim's synchronous step
+            for node in live:
+                node.swim_vnow = vnow
+                node.swim.tick(vnow)
+            for node in live:
+                await node._pump_swim()
+            await self._pump_datagrams()
+
     # -- round-paced driving (perf.manual_pacing) -------------------------
 
     async def settle(
@@ -243,7 +357,7 @@ class DevCluster:
                 quiet = 0
 
     async def step_round(
-        self, r: int, sync_interval: int = 0, rng=None
+        self, r: int, sync_interval: int = 0, rng=None, swim: bool = False
     ) -> None:
         """Drive one round of the TPU simulator's round model
         (sim/model.py) through the REAL protocol stack: every node's
@@ -251,7 +365,12 @@ class DevCluster:
         land mid-draw), then delivered over the real transport and applied
         through real ingestion; every ``sync_interval`` rounds each node
         then runs one real anti-entropy session with one uniformly chosen
-        up peer.  Requires nodes started with ``perf.manual_pacing``."""
+        up peer.  Requires nodes started with ``perf.manual_pacing``.
+        ``swim=True`` prepends a round-paced SWIM probe round
+        (:meth:`swim_phase`, perf.manual_swim) — the sim's step order:
+        SWIM, broadcast, receive, sync (sim/model.py steps 2-5)."""
+        if swim:
+            await self.swim_phase(r)
         collected = [
             (node, node.broadcast.collect_round())
             for node in self.nodes.values()
@@ -260,6 +379,14 @@ class DevCluster:
             for addr, payload in sends:
                 with contextlib.suppress(OSError, ConnectionError):
                     await node.transport.send_uni(addr, payload)
+        # send-completion barrier: the native transport's sends are
+        # fire-and-forget into the C++ core, so without a flush a
+        # delivery could land AFTER settle() declared quiescence and
+        # break per-seed round determinism
+        await asyncio.gather(
+            *(n.transport.flush() for n in self.nodes.values()),
+            return_exceptions=True,
+        )
         await self.settle()
         if sync_interval > 0 and (r + 1) % sync_interval == 0:
             rng = rng or _random.Random()

@@ -28,7 +28,7 @@ from ..agent.agent import Agent
 from ..agent.bookkeeping import Current, Partial
 from ..types.actor import ActorId
 from ..types.broadcast import ChangeSource, ChangesetEmpty, ChangesetFull, ChangeV1
-from ..types.change import MAX_CHANGES_BYTE_SIZE, Change, ChunkedChanges
+from ..types.change import Change, ChunkedChanges
 from ..types.clock import ClockDriftError
 from ..types.ranges import RangeSet
 from ..types.sync_state import SyncNeedFull, SyncNeedPartial, SyncStateV1
@@ -39,6 +39,14 @@ from .. import wire
 
 MAX_CONCURRENT_SYNCS = 3  # ref: agent.rs:131 sync permit semaphore
 MAX_CONCURRENT_VERSION_JOBS = 6  # ref: peer.rs:680-686 buffer_unordered(6)
+# Sync catch-up streams 64 KiB frames where the reference uses the 8 KiB
+# broadcast chunk size (peer.rs:350-353): an anti-entropy session rides a
+# dedicated reliable stream, so bigger frames just mean 8× fewer
+# encode/send/recv round-trips — the adaptive shrink below still drops to
+# 1 KiB on slow links.  Broadcast dissemination keeps 8 KiB
+# (types/change.py MAX_CHANGES_BYTE_SIZE): datagram-friendly payloads and
+# the retransmission economics the sim models depend on it.
+SYNC_CHUNK_BYTE_SIZE = 64 * 1024
 ADAPTIVE_MIN_CHUNK = 1024  # ref: peer.rs adaptive floor 1 KiB
 SLOW_SEND_THRESHOLD = 0.5  # ref: peer.rs:641-654 (500 ms halves the budget)
 ABORT_SEND_THRESHOLD = 5.0  # ref: peer.rs abort >5 s
@@ -314,7 +322,7 @@ class SyncServer:
             ]
         start_seq, end_seq = cover if cover is not None else (0, last_seq)
         chunker = ChunkedChanges(
-            changes, start_seq, end_seq, MAX_CHANGES_BYTE_SIZE
+            changes, start_seq, end_seq, SYNC_CHUNK_BYTE_SIZE
         )
         for chunk, seq_range in chunker:
             t0 = time.monotonic()
@@ -492,7 +500,15 @@ async def _parallel_sync_traced(
 
     async def drive(fs: FramedStream, mine: List[Tuple[ActorId, object]]) -> int:
         count = 0
-        try:
+
+        # request writer runs CONCURRENTLY with response ingestion (ref:
+        # the spawned request-writer loop, peer.rs:1124-1239).  Writing
+        # all turns before reading would mutually stall once buffers
+        # fill: all ≤6 server version jobs block on a full send buffer
+        # (this client not reading), the server's frame-read loop parks
+        # on sem.acquire, and our request sends back up behind the
+        # server's unread receive queue.
+        async def write_requests() -> None:
             for i in range(0, len(mine), REQUEST_CHUNK):
                 turn = mine[i : i + REQUEST_CHUNK]
                 by_actor: Dict[ActorId, list] = {}
@@ -501,6 +517,9 @@ async def _parallel_sync_traced(
                 await fs.send(wire.encode_sync_request(list(by_actor.items())))
                 await asyncio.sleep(0)  # yield between turns
             await fs.send(wire.pack(("request_fin",)))
+
+        writer = asyncio.create_task(write_requests())
+        try:
             while True:
                 data = await fs.recv(timeout=30.0)
                 if data is None:
@@ -514,7 +533,14 @@ async def _parallel_sync_traced(
                     await submit(payload, ChangeSource.SYNC)
                 elif kind in ("done", "rejection"):
                     break
+            # surface writer failures (a dead conn mid-request) once the
+            # response stream has drained
+            if writer.done() and not writer.cancelled():
+                writer.result()
         finally:
+            writer.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await writer
             fs.close()
         return count
 

@@ -146,6 +146,32 @@ def load() -> ctypes.CDLL:
     lib.corro_tp_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
     lib.corro_tp_stop.restype = None
     lib.corro_tp_stop.argtypes = [ctypes.c_void_p]
+    # Hot-path twin via PyDLL: these entry points only push a command
+    # under a short mutex / read a counter — microseconds, never blocking.
+    # The default CDLL releases the GIL per call and must REACQUIRE it on
+    # return; under worker-thread load (sqlite apply jobs) that costs
+    # ~1 ms per call and starves the event loop (profiled: queued_bytes
+    # at 1.6 ms/call).  PyDLL skips the GIL dance entirely.  Blocking
+    # calls (create: g++/bind, stop: thread join) stay on the CDLL.
+    fast = ctypes.PyDLL(path)
+    for name in (
+        "corro_tp_send_datagram",
+        "corro_tp_send_uni",
+        "corro_tp_bi_open",
+        "corro_tp_bi_send",
+        "corro_tp_bi_close",
+        "corro_tp_flush",
+        "corro_tp_queued_bytes",
+        "corro_tp_next_conn_id",
+        "corro_tp_stats",
+        "corro_tp_next_event",
+        "corro_tp_free",
+    ):
+        src = getattr(lib, name)
+        dst = getattr(fast, name)
+        dst.restype = src.restype
+        dst.argtypes = src.argtypes
+    lib._fast = fast
     _lib = lib
     return lib
 
@@ -165,7 +191,7 @@ class NativeFramedStream:
         await self._tp._backpressure()
         if self.closed or self._tp._handle is None:
             raise ConnectionError("stream is closed")
-        self._tp._lib.corro_tp_bi_send(
+        self._tp._flib.corro_tp_bi_send(
             self._tp._handle, self.conn_id, payload, len(payload)
         )
 
@@ -184,7 +210,7 @@ class NativeFramedStream:
         if not self.closed:
             self.closed = True
             if self._tp._handle is not None:
-                self._tp._lib.corro_tp_bi_close(self._tp._handle, self.conn_id)
+                self._tp._flib.corro_tp_bi_close(self._tp._handle, self.conn_id)
             self._tp._streams.pop(self.conn_id, None)
         with contextlib.suppress(asyncio.QueueFull):
             self.queue.put_nowait(None)
@@ -228,6 +254,8 @@ class NativeTransport:
         self._udp_sock = udp_sock
         self._tcp_sock = tcp_sock
         self._lib = load()
+        # PyDLL twin for hot non-blocking calls (see load())
+        self._flib = getattr(self._lib, "_fast", self._lib)
         self._handle: Optional[int] = None
         self._event_fd: Optional[int] = None
         self._streams: Dict[int, NativeFramedStream] = {}
@@ -318,25 +346,25 @@ class NativeTransport:
 
     def send_datagram(self, addr: Addr, payload: bytes) -> None:
         if self._handle is not None:
-            self._lib.corro_tp_send_datagram(
+            self._flib.corro_tp_send_datagram(
                 self._handle, addr[0].encode(), addr[1], payload, len(payload)
             )
 
     async def send_uni(self, addr: Addr, payload: bytes) -> None:
         await self._backpressure()
         if self._handle is not None:
-            self._lib.corro_tp_send_uni(
+            self._flib.corro_tp_send_uni(
                 self._handle, addr[0].encode(), addr[1], payload, len(payload)
             )
 
     async def open_bi(self, addr: Addr) -> NativeFramedStream:
         assert self._handle is not None
-        conn_id = self._lib.corro_tp_next_conn_id(self._handle)
+        conn_id = self._flib.corro_tp_next_conn_id(self._handle)
         stream = NativeFramedStream(self, conn_id)
         self._streams[conn_id] = stream
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._connect_waiters[conn_id] = fut
-        self._lib.corro_tp_bi_open(
+        self._flib.corro_tp_bi_open(
             self._handle, conn_id, addr[0].encode(), addr[1]
         )
         try:
@@ -353,7 +381,7 @@ class NativeTransport:
     def queued_bytes(self) -> int:
         if self._handle is None:
             return 0
-        return int(self._lib.corro_tp_queued_bytes(self._handle))
+        return int(self._flib.corro_tp_queued_bytes(self._handle))
 
     async def flush(self, timeout: float = 30.0) -> None:
         """Barrier: resolves once every byte enqueued before this call
@@ -363,7 +391,7 @@ class NativeTransport:
         token = next(self._flush_tokens)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._flush_waiters[token] = fut
-        self._lib.corro_tp_flush(self._handle, token)
+        self._flib.corro_tp_flush(self._handle, token)
         try:
             await asyncio.wait_for(fut, timeout)
         finally:
@@ -392,7 +420,7 @@ class NativeTransport:
         if self._handle is None:
             return {name: 0 for name in STAT_NAMES}
         buf = (ctypes.c_uint64 * len(STAT_NAMES))()
-        self._lib.corro_tp_stats(self._handle, buf, len(STAT_NAMES))
+        self._flib.corro_tp_stats(self._handle, buf, len(STAT_NAMES))
         return {name: int(buf[i]) for i, name in enumerate(STAT_NAMES)}
 
     # -- event pump -------------------------------------------------------
@@ -409,7 +437,7 @@ class NativeTransport:
         rtt = ctypes.c_double()
         data_ptr = ctypes.POINTER(ctypes.c_uint8)()
         data_len = ctypes.c_int()
-        while self._handle is not None and self._lib.corro_tp_next_event(
+        while self._handle is not None and self._flib.corro_tp_next_event(
             self._handle,
             ctypes.byref(etype),
             ctypes.byref(conn_id),
@@ -424,7 +452,7 @@ class NativeTransport:
             payload = b""
             if data_ptr:
                 payload = ctypes.string_at(data_ptr, data_len.value)
-                self._lib.corro_tp_free(data_ptr)
+                self._flib.corro_tp_free(data_ptr)
             self._dispatch(etype.value, conn_id.value, addr, rtt.value, payload)
 
     def _spawn(self, coro) -> None:

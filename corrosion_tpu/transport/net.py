@@ -28,7 +28,7 @@ from ..wire import WireError, deframe, frame
 Addr = Tuple[str, int]
 
 
-def bind_port_pair(host: str = "127.0.0.1"):
+def bind_port_pair(host: str = "127.0.0.1", port: int = 0):
     """Bind a UDP + TCP socket pair on one free port and hand them off.
 
     The dev-cluster harness must know every node's port before any node
@@ -37,23 +37,29 @@ def bind_port_pair(host: str = "127.0.0.1"):
     release and the node's bind (observed EADDRINUSE flakes).  Binding
     both sockets here and passing them into :class:`Transport` closes the
     window entirely.  Returns ``(port, udp_sock, tcp_sock)``.
+
+    ``port``: bind that specific port instead of a free one (node restart
+    on its previous address — harness churn mode); single attempt.
     """
     import socket as socketmod
 
+    attempts = 1 if port else 64
     last_err: Optional[OSError] = None
-    for _ in range(64):
+    for _ in range(attempts):
         udp = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_DGRAM)
         try:
-            udp.bind((host, 0))
+            # `port` stays the caller's request: a TCP-side collision on a
+            # port-0 draw must REDRAW, not retry the taken port
+            udp.bind((host, port))
         except OSError as e:
             udp.close()
             last_err = e
             continue
-        port = udp.getsockname()[1]
+        bound = udp.getsockname()[1]
         tcp = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
         tcp.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_REUSEADDR, 1)
         try:
-            tcp.bind((host, port))
+            tcp.bind((host, bound))
             tcp.listen(128)
         except OSError as e:
             udp.close()
@@ -62,24 +68,53 @@ def bind_port_pair(host: str = "127.0.0.1"):
             continue  # TCP side of this port taken; redraw
         udp.setblocking(False)
         tcp.setblocking(False)
-        return port, udp, tcp
+        return bound, udp, tcp
     raise OSError(f"could not bind a UDP+TCP port pair: {last_err}")
 
 UNI_MAGIC = b"U"
 BI_MAGIC = b"B"
+
+# transport counter names, shared shape with the native core's stats()
+# (transport/native/__init__.py STAT_NAMES; ref: the per-connection QUIC
+# stats gauges, transport.rs:235-419).  handshakes_* stay 0 here — TLS
+# handshakes are only counted inside the native core.
+STAT_NAMES = (
+    "datagrams_sent",
+    "datagrams_recv",
+    "datagram_bytes_sent",
+    "datagram_bytes_recv",
+    "frames_sent",
+    "frames_recv",
+    "stream_bytes_sent",
+    "stream_bytes_recv",
+    "conns_accepted",
+    "conns_connected",
+    "conns_dropped",
+    "conns_open",
+    "queued_bytes",
+    "handshakes_ok",
+    "handshakes_failed",
+)
 
 
 class FramedStream:
     """Length-delimited frame reader/writer over an asyncio stream."""
 
     def __init__(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        stats: Optional[dict] = None,
     ) -> None:
         self.reader = reader
         self.writer = writer
         self._buf = bytearray()
+        self._stats = stats
 
     async def send(self, payload: bytes) -> None:
+        if self._stats is not None:
+            self._stats["frames_sent"] += 1
+            self._stats["stream_bytes_sent"] += len(payload) + 4
         self.writer.write(frame(payload))
         await self.writer.drain()
 
@@ -92,6 +127,9 @@ class FramedStream:
             payload, consumed = deframe(memoryview(self._buf))
             if payload is not None:
                 del self._buf[:consumed]
+                if self._stats is not None:
+                    self._stats["frames_recv"] += 1
+                    self._stats["stream_bytes_recv"] += consumed
                 return payload
             if deadline is None:
                 chunk = await self.reader.read(65536)
@@ -165,6 +203,13 @@ class Transport:
         self._inbound: set = set()
         # rtt samples callback (ref: transport.rs:220 feeds members)
         self.on_rtt: Optional[Callable[[Addr, float], None]] = None
+        self._stats = {name: 0 for name in STAT_NAMES}
+
+    def stats(self) -> Dict[str, int]:
+        """Transport counters (same shape as NativeTransport.stats)."""
+        out = dict(self._stats)
+        out["conns_open"] = len(self._inbound) + len(self._uni_conns)
+        return out
 
     # -- lifecycle --------------------------------------------------------
 
@@ -220,6 +265,8 @@ class Transport:
             self._tcp = None
 
     def _handle_datagram(self, addr: Addr, data: bytes) -> None:
+        self._stats["datagrams_recv"] += 1
+        self._stats["datagram_bytes_recv"] += len(data)
         self.on_datagram(addr, data)
 
     async def _handle_conn(
@@ -232,7 +279,8 @@ class Transport:
         except (asyncio.IncompleteReadError, ConnectionError):
             writer.close()
             return
-        fs = FramedStream(reader, writer)
+        self._stats["conns_accepted"] += 1
+        fs = FramedStream(reader, writer, stats=self._stats)
         self._inbound.add(fs)
         try:
             if magic == UNI_MAGIC:
@@ -255,6 +303,8 @@ class Transport:
 
     def send_datagram(self, addr: Addr, payload: bytes) -> None:
         if self._udp is not None:
+            self._stats["datagrams_sent"] += 1
+            self._stats["datagram_bytes_sent"] += len(payload)
             self._udp.sendto(payload, addr)
 
     async def _open_stream(self, addr: Addr):
@@ -270,7 +320,8 @@ class Transport:
         if self.on_rtt is not None:
             self.on_rtt(addr, (time.monotonic() - t0) * 1000.0)
         writer.write(UNI_MAGIC)
-        fs = FramedStream(reader, writer)
+        self._stats["conns_connected"] += 1
+        fs = FramedStream(reader, writer, stats=self._stats)
         self._uni_conns[addr] = fs
         return fs
 
@@ -286,10 +337,16 @@ class Transport:
                 await fs.send(payload)
             except (ConnectionError, OSError):
                 # stale cached conn: drop it and retry once fresh
+                self._stats["conns_dropped"] += 1
                 fs.close()
                 self._uni_conns.pop(addr, None)
                 fs = await self._connect_uni(addr)
                 await fs.send(payload)
+
+    async def flush(self, timeout: float = 30.0) -> None:
+        """Send-completion barrier (API parity with NativeTransport.flush).
+        ``send_uni``/``send_datagram`` already await every byte into the
+        kernel before returning, so the barrier is trivially satisfied."""
 
     async def open_bi(self, addr: Addr) -> FramedStream:
         t0 = time.monotonic()
@@ -297,4 +354,5 @@ class Transport:
         if self.on_rtt is not None:
             self.on_rtt(addr, (time.monotonic() - t0) * 1000.0)
         writer.write(BI_MAGIC)
-        return FramedStream(reader, writer)
+        self._stats["conns_connected"] += 1
+        return FramedStream(reader, writer, stats=self._stats)

@@ -108,11 +108,27 @@ class PerfConfig:
     # so rounds-to-convergence is countable against the TPU round model
     # (the virtual-time hook SURVEY.md §7 step 8 calls for).
     manual_pacing: bool = False
+    # Round-paced SWIM (requires manual_pacing): the node does not
+    # free-run its SWIM tick/announce loops and its SWIM clock is VIRTUAL
+    # — the harness advances it one probe period per round
+    # (DevCluster.swim_phase), so failure detection (probe → suspect →
+    # down → rejoin) runs round-synchronously against the sim's churn
+    # model (sim/model.py step 2/6)
+    manual_swim: bool = False
 
 
 @dataclass
 class AdminConfig:
     uds_path: Optional[str] = None
+
+
+@dataclass
+class LogConfig:
+    """Logging output control (ref: config.rs:245-255 LogConfig —
+    ``format`` plaintext/json, ``colors`` on by default)."""
+
+    format: str = "plaintext"  # "plaintext" | "json"
+    colors: bool = True
 
 
 @dataclass
@@ -132,6 +148,7 @@ class Config:
     perf: PerfConfig = field(default_factory=PerfConfig)
     admin: AdminConfig = field(default_factory=AdminConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    log: LogConfig = field(default_factory=LogConfig)
 
     @staticmethod
     def load(path: str) -> "Config":

@@ -1,0 +1,103 @@
+"""Logging setup driven by the ``[log]`` config section.
+
+Equivalent of the reference's tracing-subscriber wiring
+(crates/corrosion/src/main.rs:55-134 picks plaintext-vs-JSON from
+``config.log.format``; crates/corro-types/src/config.rs:245-255 defines
+``LogConfig { format, colors }``).  Plaintext gets ANSI level colouring on
+TTYs (``colors = true``, the default); JSON emits one object per record
+with timestamp/level/target/message + exception details, matching the
+shape of tracing's ``fmt::format::Json`` layer.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+import traceback
+from typing import Optional
+
+from ..types.config import LogConfig
+
+_LEVEL_COLORS = {
+    "DEBUG": "\x1b[34m",  # blue
+    "INFO": "\x1b[32m",  # green
+    "WARNING": "\x1b[33m",  # yellow
+    "ERROR": "\x1b[31m",  # red
+    "CRITICAL": "\x1b[1;31m",  # bold red
+}
+_RESET = "\x1b[0m"
+_DIM = "\x1b[2m"
+
+
+class PlaintextFormatter(logging.Formatter):
+    """``2026-07-30T12:00:00.123Z  INFO corrosion_tpu.agent.node: msg``."""
+
+    def __init__(self, colors: bool) -> None:
+        super().__init__()
+        self.colors = colors
+
+    def format(self, record: logging.LogRecord) -> str:
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created))
+        ts = f"{ts}.{int(record.msecs):03d}Z"
+        level = record.levelname
+        msg = record.getMessage()
+        if record.exc_info:
+            msg += "\n" + "".join(traceback.format_exception(*record.exc_info)).rstrip()
+        if self.colors:
+            color = _LEVEL_COLORS.get(level, "")
+            return (
+                f"{_DIM}{ts}{_RESET} {color}{level:>7}{_RESET} "
+                f"{_DIM}{record.name}:{_RESET} {msg}"
+            )
+        return f"{ts} {level:>7} {record.name}: {msg}"
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per record (ref: tracing JSON layer field shape)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "timestamp": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)
+            )
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exception"] = "".join(
+                traceback.format_exception(*record.exc_info)
+            ).rstrip()
+        return json.dumps(out, default=str)
+
+
+def setup_logging(
+    cfg: Optional[LogConfig] = None,
+    *,
+    level: int = logging.INFO,
+    stream=None,
+) -> logging.Handler:
+    """Install a root handler per the ``[log]`` section; returns it.
+
+    Idempotent: replaces any handler a previous call installed (marked by
+    ``_corro_log``) instead of stacking duplicates.
+    """
+    cfg = cfg or LogConfig()
+    stream = stream if stream is not None else sys.stderr
+    colors = cfg.colors and hasattr(stream, "isatty") and stream.isatty()
+    handler = logging.StreamHandler(stream)
+    if cfg.format == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(PlaintextFormatter(colors))
+    handler._corro_log = True  # type: ignore[attr-defined]
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        if getattr(h, "_corro_log", False):
+            root.removeHandler(h)
+    root.addHandler(handler)
+    root.setLevel(level)
+    return handler

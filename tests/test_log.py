@@ -1,0 +1,72 @@
+"""[log] config section + formatters (ref: config.rs:245-255 LogConfig,
+plaintext/JSON pick at corrosion/src/main.rs:55-134)."""
+
+import io
+import json
+import logging
+
+from corrosion_tpu.types.config import Config, LogConfig
+from corrosion_tpu.utils.log import setup_logging
+
+
+def _capture(cfg: LogConfig, emit) -> str:
+    buf = io.StringIO()
+    handler = setup_logging(cfg, stream=buf)
+    try:
+        emit(logging.getLogger("corro.test"))
+    finally:
+        logging.getLogger().removeHandler(handler)
+    return buf.getvalue()
+
+
+def test_config_log_section_parses(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text('[db]\npath = ":memory:"\n\n[log]\nformat = "json"\ncolors = false\n')
+    cfg = Config.load(str(p))
+    assert cfg.log.format == "json"
+    assert cfg.log.colors is False
+    # defaults (ref: config.rs default_as_true for colors)
+    assert Config().log.format == "plaintext" and Config().log.colors is True
+
+
+def test_json_format_one_object_per_record():
+    out = _capture(
+        LogConfig(format="json"),
+        lambda lg: (lg.info("hello %s", "world"), lg.warning("warn")),
+    )
+    lines = [json.loads(l) for l in out.strip().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["message"] == "hello world"
+    assert lines[0]["level"] == "INFO"
+    assert lines[0]["target"] == "corro.test"
+    assert lines[1]["level"] == "WARNING"
+    assert "timestamp" in lines[0]
+
+
+def test_json_format_exception_field():
+    def emit(lg):
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            lg.exception("failed")
+
+    rec = json.loads(_capture(LogConfig(format="json"), emit).strip())
+    assert rec["level"] == "ERROR"
+    assert "ValueError: boom" in rec["exception"]
+
+
+def test_plaintext_no_colors_on_non_tty():
+    # colors=True but a StringIO stream is not a TTY → no ANSI escapes
+    out = _capture(LogConfig(colors=True), lambda lg: lg.info("plain message"))
+    assert "plain message" in out
+    assert "\x1b[" not in out
+    assert "INFO" in out and "corro.test" in out
+
+
+def test_setup_is_idempotent():
+    buf = io.StringIO()
+    h1 = setup_logging(LogConfig(), stream=buf)
+    h2 = setup_logging(LogConfig(), stream=buf)
+    ours = [h for h in logging.getLogger().handlers if getattr(h, "_corro_log", False)]
+    assert ours == [h2] and h1 not in logging.getLogger().handlers
+    logging.getLogger().removeHandler(h2)

@@ -191,15 +191,13 @@ async def harness_mean_rounds(n, k, mt, sync_interval, n_trials, nseq_max=1):
         seeded_actors=True,
         config_tweaks={
             "perf": {"manual_pacing": True, "flush_interval": 0.01},
-            # round-paced mode needs synchronous-send semantics: the
-            # python transport awaits every frame into the kernel before
-            # the settle barrier starts counting, while the native core's
-            # fire-and-forget sends can land a delivery after the barrier
-            # under machine load, breaking per-seed determinism
+            # round-paced mode needs synchronous-send semantics; the
+            # harness's step_round flush barrier provides them on BOTH
+            # transport impls, so the shipping default (native) is the
+            # one under test here
             "gossip": {
                 "suspicion_timeout": 30.0,
                 "max_transmissions": mt,
-                "transport_impl": "python",
             },
         },
     )
@@ -300,4 +298,201 @@ def test_round_counts_chunked_payloads():
     (measured means: harness 4.667 vs sim 4.680 — 0.28%)."""
     _assert_fidelity(
         n=16, k=8, mt=2, sync_interval=5, n_trials=24, nseq_max=4
+    )
+
+
+# -- churn mode: failure dynamics against the real runtime -----------------
+#
+# The headline configs (4/5) are DEFINED by churn: nodes die mid-
+# dissemination, get suspected/declared-down by real SWIM probes, restart
+# as fresh replacements holding only their own writes, and recover the
+# rest via anti-entropy (sim/model.py steps 2+6).  This experiment drives
+# that machinery through the REAL stack: perf.manual_swim round-paces the
+# real SWIM core (virtual clock, one probe round per round, suspicion
+# expiry on round boundaries), DevCluster.kill() crash-stops nodes (no
+# leave — peers must DETECT the death), and DevCluster.restart() boots a
+# replacement on the same address with a renewed identity.
+#
+# Experimental design — PAIRED randomness: the death schedule and write
+# origins dominate round-count variance (a 0-death trial converges rounds
+# before a 2-death trial), so each harness trial replays the SIM's exact
+# hash-drawn death schedule + origins for that seed (sim/rng.py py_below
+# is the deterministic draw both backends share).  Means over the same
+# seed set then differ only by the dissemination/probe dynamics under
+# test, not by which trials happened to draw deaths — without pairing,
+# ±2% on the mean would need hundreds of trials.
+#
+# swim_impl is pinned to "python" here: per-trial seeded probe rngs are
+# what make trials reproducible, and the native core's internal rng is
+# not seedable from the harness.  The cores are wire-compatible and
+# interop-tested (tests/test_swim_native.py); the round-model fidelity
+# being measured is impl-independent.
+
+from corrosion_tpu.sim.rng import TAG_CHURN, TAG_ORIGIN, py_below  # noqa: E402
+
+SUSPICION_ROUNDS = 3
+PROBE_TIMEOUT = 0.3
+
+
+def sim_death_schedule(p: SimParams):
+    """{round: [node, ...]} — the sim's exact churn draws for this seed."""
+    return {
+        x: [
+            n
+            for n in range(p.n_nodes)
+            if py_below(1_000_000, p.seed, TAG_CHURN, x, n) < p.churn_ppm
+        ]
+        for x in range(p.churn_rounds)
+    }
+
+
+def sim_origins(p: SimParams):
+    return [py_below(p.n_nodes, p.seed, TAG_ORIGIN, k) for k in range(p.n_changes)]
+
+
+def _arm(node, trial_seed, i, next_probe_at=0.0):
+    """Per-trial determinism: freeze RTT rings (loopback would put every
+    member in ring0 → broadcast-to-all) and seed the broadcast + SWIM
+    rngs."""
+    node.transport.on_rtt = None
+    for m in node.members.states.values():
+        m.ring = None
+        m.rtts.clear()
+    node.broadcast.rng = random.Random((trial_seed + 1) * 1000 + i)
+    node.swim.rng = random.Random((trial_seed + 1) * 77_000 + i)
+    node.swim._next_probe_at = next_probe_at
+
+
+async def one_churn_trial(p: SimParams, names):
+    n = p.n_nodes
+    cluster = DevCluster(
+        star_topology(n)[0],
+        schema=SCHEMA,
+        seeded_actors=True,
+        config_tweaks={
+            "perf": {
+                "manual_pacing": True,
+                "manual_swim": True,
+                "flush_interval": 0.01,
+            },
+            "gossip": {
+                "max_transmissions": p.max_transmissions,
+                "swim_impl": "python",
+                "probe_period": 1.0,
+                "probe_timeout": PROBE_TIMEOUT,
+                # suspect at ~+0.7 in its round; DOWN on the round
+                # boundary SUSPICION_ROUNDS later (harness/swim_phase)
+                "suspicion_timeout": SUSPICION_ROUNDS - 0.7,
+            },
+        },
+    )
+    await cluster.start()
+    nodes = {name: cluster[name] for name in names}
+    cluster.seed_full_membership()
+    for i, name in enumerate(names):
+        _arm(nodes[name], p.seed, i)
+
+    rng = random.Random(5_000_000 + p.seed)  # sync-peer draws only
+    deaths = sim_death_schedule(p)
+    writes: dict = {name: [] for name in names}
+    expected_heads: dict = {}
+    try:
+        # paired injection: the sim's origins for this seed, all round 0
+        for k, origin in enumerate(sim_origins(p)):
+            name = names[origin]
+            node = nodes[name]
+            stmts = [
+                (
+                    "INSERT INTO tests (id,text) VALUES (?,?)",
+                    (next(_ids), "x" * 40),
+                )
+            ]
+            writes[name].append(stmts)
+            out = await make_broadcastable_changes(node.agent, stmts)
+            await node.broadcast.enqueue(out.changesets)
+            aid = node.agent.actor_id
+            expected_heads[aid] = expected_heads.get(aid, 0) + 1
+
+        down_until: dict = {}  # name -> round its replacement boots
+        for r in range(MAX_ROUNDS):
+            # restarts due this round, before the SWIM phase (sim: a
+            # death at x is unresponsive x+1..x+D, announces at x+D+1)
+            for name in [m for m, rr in list(down_until.items()) if rr <= r]:
+                del down_until[name]
+                node = await cluster.restart(name)
+                nodes[name] = node
+                _arm(node, p.seed, names.index(name), next_probe_at=float(r))
+                cluster.seed_full_membership(now=float(r))
+                await cluster.announce_all(node)
+                # replacement re-registers its own writes (fresh budgets)
+                for stmts in writes[name]:
+                    out = await make_broadcastable_changes(node.agent, stmts)
+                    await node.broadcast.enqueue(out.changesets)
+            await cluster.step_round(
+                r, sync_interval=p.sync_interval, rng=rng, swim=True
+            )
+            # churn deaths at end of round (sim step 6); draws hit dead
+            # nodes too — their down window extends
+            for victim in deaths.get(r, ()):
+                name = names[victim]
+                if name in cluster.nodes:
+                    await cluster.kill(name)
+                down_until[name] = r + p.churn_down_rounds + 1
+            if not down_until and _converged(
+                list(cluster.nodes.values()), expected_heads
+            ):
+                return r + 1
+        raise AssertionError(
+            f"churn trial seed={p.seed} did not converge in {MAX_ROUNDS}"
+        )
+    finally:
+        await cluster.stop()
+
+
+def churn_params(n, k, mt, sync_interval, ppm, churn_rounds, down, seed):
+    return SimParams(
+        n_nodes=n, n_changes=k, fanout=3, max_transmissions=mt,
+        sync_interval=sync_interval, write_rounds=1, max_rounds=MAX_ROUNDS,
+        churn_ppm=ppm, churn_rounds=churn_rounds, churn_down_rounds=down,
+        swim=True, swim_suspicion=True,
+        swim_suspicion_rounds=SUSPICION_ROUNDS,
+        fanout_per_change=True, seed=seed,
+    )
+
+
+def _assert_churn_fidelity(n, k, mt, sync_interval, ppm, churn_rounds, down,
+                           n_trials):
+    _, names = star_topology(n)
+    hr, sr = [], []
+    total_deaths = 0
+    for seed in range(n_trials):
+        p = churn_params(n, k, mt, sync_interval, ppm, churn_rounds, down,
+                         seed)
+        total_deaths += sum(len(v) for v in sim_death_schedule(p).values())
+        hr.append(asyncio.run(one_churn_trial(p, names)))
+        res = run_reference(p)
+        assert res.converged
+        sr.append(res.rounds)
+    assert total_deaths >= n_trials, (
+        f"churn config too weak: {total_deaths} deaths over {n_trials} "
+        "trials does not exercise failure dynamics"
+    )
+    mh, ms = statistics.mean(hr), statistics.mean(sr)
+    gap = abs(mh - ms) / ms
+    assert gap <= TOLERANCE, (
+        f"churn fidelity broken: harness mean={mh:.3f} ({hr}) vs "
+        f"sim mean={ms:.3f} ({sr}) — gap {gap*100:.2f}% > ±2%"
+    )
+
+
+def test_round_counts_churn():
+    """16 nodes, 8 changesets, budget 2, sync every 3, ~9%/round churn
+    for rounds 0-2 with 3-round down windows: deaths interrupt
+    dissemination mid-flight, real SWIM probes must suspect the dead
+    (suspicion window 3 rounds ≈ the down window, the regime of BASELINE
+    config 4), replacements re-register their own writes and recover the
+    rest via real anti-entropy sessions."""
+    _assert_churn_fidelity(
+        n=16, k=8, mt=2, sync_interval=3, ppm=90_000, churn_rounds=3,
+        down=3, n_trials=24,
     )

@@ -228,3 +228,84 @@ def test_version_jobs_bounded_concurrency():
         a.close()
 
     run(main())
+
+
+def test_no_mutual_stall_when_needs_exceed_buffers(monkeypatch):
+    """Interleaved request turns (ref: the spawned request-writer loop,
+    peer.rs:1124-1239): the need list exceeds the server's job window AND
+    the socket path's buffer capacity, so a client that wrote all request
+    turns before reading any response would deadlock — all ≤6 server
+    version jobs parked on a full send buffer, the server's frame-read
+    loop parked on sem.acquire, the client's request sends backed up
+    behind the server's unread receive queue.  The concurrent
+    reader/writer client must complete the whole transfer."""
+    import socket
+
+    from corrosion_tpu.sync import session as session_mod
+    from corrosion_tpu.transport.net import FramedStream
+
+    async def main():
+        a = mkagent()
+        for i in range(400):
+            await make_broadcastable_changes(
+                a,
+                [
+                    (
+                        "INSERT INTO tests (id, text) VALUES (?, ?)",
+                        (i, "x" * 512),
+                    )
+                ],
+            )
+        b = mkagent()
+
+        # tiny kernel buffers + zero user-space write buffering: drain()
+        # blocks as soon as the kernel path is full (Linux clamps to the
+        # floor values, still far below the 120 KiB of response bytes)
+        s1, s2 = socket.socketpair()
+        for s in (s1, s2):
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        # limit= shrinks the StreamReader's user-space buffer (default
+        # 64 KiB/direction would absorb the whole request stream and
+        # mask the stall)
+        r1, w1 = await asyncio.open_connection(sock=s1, limit=1024)
+        r2, w2 = await asyncio.open_connection(sock=s2, limit=1024)
+        w1.transport.set_write_buffer_limits(high=0)
+        w2.transport.set_write_buffer_limits(high=0)
+        fs_client = FramedStream(r1, w1)
+        fs_server = FramedStream(r2, w2)
+
+        # one need per request frame: request bytes outgrow the socket
+        # path so the writer genuinely blocks mid-session
+        monkeypatch.setattr(session_mod, "FULL_RANGE_CHUNK", 1)
+        monkeypatch.setattr(session_mod, "REQUEST_CHUNK", 1)
+
+        class StubTransport:
+            async def open_bi(self, addr):
+                return fs_client
+
+        server_task = asyncio.create_task(
+            session_mod.SyncServer(a).serve(("127.0.0.1", 1), fs_server)
+        )
+        received = []
+
+        async def submit(payload, src):
+            received.append(payload)
+
+        n = await asyncio.wait_for(
+            session_mod.parallel_sync(
+                b,
+                StubTransport(),
+                [(a.actor_id, ("127.0.0.1", 1))],
+                submit,
+            ),
+            timeout=20.0,
+        )
+        await asyncio.wait_for(server_task, timeout=5.0)
+        assert n == len(received) == 400
+        versions = {cv.changeset.versions for cv in received}
+        assert versions == {(v, v) for v in range(1, 401)}
+        w1.close(), w2.close()
+        a.close(), b.close()
+
+    run(main())

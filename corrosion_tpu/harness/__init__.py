@@ -112,6 +112,24 @@ class DevCluster:
         self.seeded_actors = seeded_actors
         self.nodes: Dict[str, "Node"] = {}  # noqa: F821
         self._ports: Dict[str, int] = {}
+        # -- delivery ledger (round-paced determinism under load) ---------
+        # Wall-clock pump cycles (sleep N ms and hope loopback delivered)
+        # made round-paced trials load-sensitive: under a busy machine a
+        # probe ack or broadcast frame could land AFTER the barrier that
+        # was supposed to cover it, shifting round counts (the round-4
+        # churn-fidelity flake).  Instead the harness counts every
+        # datagram/uni-frame sent to a CURRENTLY-LIVE node and every one
+        # handled, and barriers on got == expected — delivery time drops
+        # out of the experiment entirely.
+        perf = dict(self.config_tweaks.get("perf") or {})
+        self._track_uni = bool(perf.get("manual_pacing"))
+        self._track_dgram = bool(perf.get("manual_swim"))
+        self._live_addrs: set = set()
+        self._dgram_exp = 0
+        self._dgram_got = 0
+        self._uni_exp = 0
+        self._uni_got = 0
+        self._drain_timeouts = 0
 
     def _make_config(self, name: str):
         from ..types.config import Config
@@ -166,7 +184,100 @@ class DevCluster:
             await node.agent.pool.write_call(
                 lambda c, s=self.schema: apply_schema(c, s)
             )
+        self._instrument(node)
         return node
+
+    def _instrument(self, node) -> None:
+        """Wrap the node's transport send/receive callbacks with delivery
+        accounting (see the ledger note in ``__init__``).  Sends to dead
+        addresses are NOT expected — a crash-stopped node's traffic just
+        vanishes, exactly like the real network.  Receive counters are
+        bumped AFTER the handler ran, so got==exp means every in-flight
+        message has been fully HANDLED, not merely delivered."""
+        tp = node.transport
+        if self._track_dgram:
+            orig_send_dg = tp.send_datagram
+
+            def send_dg(addr, payload, _o=orig_send_dg):
+                # count BEFORE the send (delivery can complete and be
+                # clamped mid-send otherwise), uncount on failure so a
+                # raising send leaves no phantom expectation
+                track = (addr[0], addr[1]) in self._live_addrs
+                if track:
+                    self._dgram_exp += 1
+                try:
+                    _o(addr, payload)
+                except BaseException:
+                    if track:
+                        self._dgram_exp -= 1
+                    raise
+
+            tp.send_datagram = send_dg
+            orig_on_dg = tp.on_datagram
+
+            def on_dg(addr, data, _o=orig_on_dg):
+                _o(addr, data)
+                # clamp: after a timeout reconcile, a late straggler must
+                # not push got past exp and weaken later barriers
+                if self._dgram_got < self._dgram_exp:
+                    self._dgram_got += 1
+
+            tp.on_datagram = on_dg
+        if self._track_uni:
+            orig_send_uni = tp.send_uni
+
+            async def send_uni(addr, payload, _o=orig_send_uni):
+                track = (addr[0], addr[1]) in self._live_addrs
+                if track:
+                    self._uni_exp += 1
+                try:
+                    await _o(addr, payload)
+                except BaseException:
+                    if track:
+                        self._uni_exp -= 1
+                    raise
+
+            tp.send_uni = send_uni
+            orig_on_uni = tp.on_uni_frame
+
+            async def on_uni(addr, payload, _o=orig_on_uni):
+                await _o(addr, payload)
+                if self._uni_got < self._uni_exp:
+                    self._uni_got += 1
+
+            tp.on_uni_frame = on_uni
+
+    async def drain_deliveries(self, timeout: float = 20.0) -> bool:
+        """Count-based delivery barrier: flush every transport, then wait
+        until every tracked message sent to a live node has been handled.
+        Replaces sleep-and-hope pump cycles — under machine load this
+        waits exactly as long as delivery actually takes, so round-paced
+        outcomes stop depending on the scheduler.  Returns False (after
+        ``timeout``) only if the kernel genuinely dropped a datagram —
+        rare enough on loopback that the fallback is to proceed."""
+        deadline = time.monotonic() + timeout
+        # flush ONCE: in the tracked manual modes nothing sends while this
+        # loop waits (handler follow-ups only surface at the next pump),
+        # so per-poll re-flushes would be pure overhead
+        await asyncio.gather(
+            *(n.transport.flush() for n in list(self.nodes.values())),
+            return_exceptions=True,
+        )
+        while True:
+            if (
+                self._dgram_got >= self._dgram_exp
+                and self._uni_got >= self._uni_exp
+            ):
+                return True
+            if time.monotonic() > deadline:
+                # reconcile: a genuinely lost message (kernel-dropped
+                # datagram, failed send after exp was counted) must not
+                # turn every later barrier into a full-timeout stall
+                self._drain_timeouts += 1
+                self._dgram_got = self._dgram_exp
+                self._uni_got = self._uni_exp
+                return False
+            await asyncio.sleep(0.002)
 
     async def start(self) -> "DevCluster":
         from ..transport.net import bind_port_pair
@@ -182,6 +293,7 @@ class DevCluster:
         order = self.topology.leaves() + self.topology.initiators()
         try:
             for name in order:
+                self._live_addrs.add(("127.0.0.1", self._ports[name]))
                 self.nodes[name] = await self._boot_node(
                     name, socks.pop(name)
                 )
@@ -241,6 +353,7 @@ class DevCluster:
         the harness realization of the sim's churn deaths (sim/model.py
         step 6).  The port stays reserved in ``self._ports`` for
         :meth:`restart`."""
+        self._live_addrs.discard(("127.0.0.1", self._ports[name]))
         node = self.nodes.pop(name)
         await node.stop(crash=True)
 
@@ -255,6 +368,7 @@ class DevCluster:
         from ..transport.net import bind_port_pair
 
         socks = bind_port_pair(port=self._ports[name])
+        self._live_addrs.add(("127.0.0.1", self._ports[name]))
         node = await self._boot_node(name, socks)
         self.nodes[name] = node
         return node
@@ -294,11 +408,29 @@ class DevCluster:
                 node.members.add_member(other)
 
     async def _pump_datagrams(self, cycles: int = 3) -> None:
-        """Drain multi-hop SWIM exchanges: each cycle flushes every
-        node's queued sends into the kernel, lets loopback deliver them
-        (handlers run on receipt), then pumps the responses they queued.
-        Three cycles cover the longest chain (ping_req → fwd_ping →
-        ack)."""
+        """Drain multi-hop SWIM exchanges to completion.
+
+        With the delivery ledger active (perf.manual_swim), this is a
+        deterministic fixpoint: barrier on every in-flight datagram being
+        HANDLED, pump the responses the handlers queued, repeat until a
+        pump emits nothing new.  The longest chain (ping_req → fwd_ping
+        → ack) converges in 3 iterations; the cap covers feed/announce
+        storms after restarts.  Without the ledger (real-time SWIM),
+        falls back to timed pump cycles."""
+        if self._track_dgram:
+            for _ in range(12):
+                if not await self.drain_deliveries():
+                    return  # reconciled after a loss; don't queue more
+                before = self._dgram_exp
+                for node in list(self.nodes.values()):
+                    with contextlib.suppress(Exception):
+                        await node._pump_swim()
+                if self._dgram_exp == before:
+                    return
+            # cap hit with the last pump's sends still in flight: drain
+            # them so nothing lands mid-sub-tick next phase
+            await self.drain_deliveries()
+            return
         for _ in range(cycles):
             live = list(self.nodes.values())
             await asyncio.gather(
@@ -379,14 +511,20 @@ class DevCluster:
             for addr, payload in sends:
                 with contextlib.suppress(OSError, ConnectionError):
                     await node.transport.send_uni(addr, payload)
-        # send-completion barrier: the native transport's sends are
-        # fire-and-forget into the C++ core, so without a flush a
-        # delivery could land AFTER settle() declared quiescence and
-        # break per-seed round determinism
-        await asyncio.gather(
-            *(n.transport.flush() for n in self.nodes.values()),
-            return_exceptions=True,
-        )
+        # delivery barrier: flush pushes every send into the kernel, and
+        # the ledger (when active) then waits until each frame sent to a
+        # live node has been RECEIVED AND SUBMITTED to ingestion — without
+        # it a slow-scheduled delivery could land after settle() declared
+        # quiescence and leak into the next round (the round-4 flake);
+        # drain_deliveries flushes internally, so flush separately only
+        # in the untracked fallback
+        if self._track_uni or self._track_dgram:
+            await self.drain_deliveries()
+        else:
+            await asyncio.gather(
+                *(n.transport.flush() for n in self.nodes.values()),
+                return_exceptions=True,
+            )
         await self.settle()
         if sync_interval > 0 and (r + 1) % sync_interval == 0:
             rng = rng or _random.Random()

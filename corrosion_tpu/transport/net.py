@@ -345,8 +345,25 @@ class Transport:
 
     async def flush(self, timeout: float = 30.0) -> None:
         """Send-completion barrier (API parity with NativeTransport.flush).
-        ``send_uni``/``send_datagram`` already await every byte into the
-        kernel before returning, so the barrier is trivially satisfied."""
+        ``drain()`` only enforces the high-watermark, so with a backed-up
+        socket bytes can still sit in the asyncio transport buffer after
+        ``send_uni`` returns; wait here until every cached uni writer's
+        buffer is empty so round-paced callers get true into-the-kernel
+        semantics."""
+        deadline = time.monotonic() + timeout
+        for fs in list(self._uni_conns.values()):
+            while True:
+                tr = fs.writer.transport
+                if tr is None or tr.is_closing():
+                    break
+                if tr.get_write_buffer_size() == 0:
+                    break
+                if time.monotonic() > deadline:
+                    # NativeTransport.flush raises on deadline too —
+                    # callers must not mistake a stalled peer for a
+                    # completed barrier
+                    raise asyncio.TimeoutError("transport flush deadline")
+                await asyncio.sleep(0.001)
 
     async def open_bi(self, addr: Addr) -> FramedStream:
         t0 = time.monotonic()

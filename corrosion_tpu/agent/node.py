@@ -205,6 +205,7 @@ class Node:
             probe_period=self.config.gossip.probe_period,
             probe_timeout=self.config.gossip.probe_timeout,
             suspicion_timeout=self.config.gossip.suspicion_timeout,
+            announce_down_period=self.config.gossip.announce_down_period,
         )
         impl = self.config.gossip.swim_impl
         if impl not in ("native", "python"):

@@ -130,6 +130,13 @@ class DevCluster:
         self._uni_exp = 0
         self._uni_got = 0
         self._drain_timeouts = 0
+        # -- partition injection ------------------------------------------
+        # addr -> side; while active, cross-side traffic is dropped at the
+        # SENDER (datagrams and uni frames silently, bi/sync connects with
+        # ConnectionError) — the harness realization of the sim's two-sided
+        # partition (sim/model.py step 7)
+        self._part_sides: Dict[Tuple[str, int], int] = {}
+        self._part_active = False
 
     def _make_config(self, name: str):
         from ..types.config import Config
@@ -185,7 +192,58 @@ class DevCluster:
                 lambda c, s=self.schema: apply_schema(c, s)
             )
         self._instrument(node)
+        self._install_partition_filter(node)
         return node
+
+    def set_partition(self, sides: Dict[str, int]) -> None:
+        """Split the cluster by node name → side.  All traffic between
+        nodes on different sides is dropped at the sender until
+        :meth:`heal_partition`; nodes not named are unaffected."""
+        self._part_sides = {
+            ("127.0.0.1", self._ports[name]): side
+            for name, side in sides.items()
+        }
+        self._part_active = True
+
+    def heal_partition(self) -> None:
+        self._part_active = False
+
+    def _install_partition_filter(self, node) -> None:
+        """Sender-side cross-partition drop.  Installed OUTSIDE the
+        delivery ledger's wrappers (after :meth:`_instrument`), so dropped
+        traffic is never counted as expected."""
+        tp = node.transport
+        my_addr = (node.transport.host, node.transport.port)
+
+        def blocked(dest) -> bool:
+            if not self._part_active:
+                return False
+            a = self._part_sides.get(my_addr)
+            b = self._part_sides.get((dest[0], dest[1]))
+            return a is not None and b is not None and a != b
+
+        orig_dg = tp.send_datagram
+
+        def send_dg(addr, payload, _o=orig_dg):
+            if not blocked(addr):
+                _o(addr, payload)
+
+        tp.send_datagram = send_dg
+        orig_uni = tp.send_uni
+
+        async def send_uni(addr, payload, _o=orig_uni):
+            if not blocked(addr):
+                await _o(addr, payload)
+
+        tp.send_uni = send_uni
+        orig_bi = tp.open_bi
+
+        async def open_bi(addr, _o=orig_bi):
+            if blocked(addr):
+                raise ConnectionError("cluster partitioned (harness filter)")
+            return await _o(addr)
+
+        tp.open_bi = open_bi
 
     def _instrument(self, node) -> None:
         """Wrap the node's transport send/receive callbacks with delivery
@@ -247,7 +305,7 @@ class DevCluster:
 
             tp.on_uni_frame = on_uni
 
-    async def drain_deliveries(self, timeout: float = 20.0) -> bool:
+    async def drain_deliveries(self, timeout: float = 60.0) -> bool:
         """Count-based delivery barrier: flush every transport, then wait
         until every tracked message sent to a live node has been handled.
         Replaces sleep-and-hope pump cycles — under machine load this
@@ -472,7 +530,7 @@ class DevCluster:
         self,
         quiet_checks: int = 4,
         interval: float = 0.02,
-        timeout: float = 30.0,
+        timeout: float = 60.0,
     ) -> None:
         """Wait until every node's ingestion pipeline has been quiescent
         for ``quiet_checks`` consecutive polls — the barrier between

@@ -50,6 +50,12 @@ class SwimConfig:
     max_piggyback: int = 8  # updates per message (≈ 1178-byte datagram budget)
     update_retransmits: int = 6  # times each update is piggybacked
     remove_down_after: float = 48 * 3600.0  # ref: broadcast/mod.rs:744
+    # partition-heal: periodically announce to one random DOWN member so a
+    # healed link is rediscovered without operator intervention (ref: foca
+    # periodic announce to down members, part of the WAN tuning the
+    # reference uses; without it a two-sided partition NEVER re-merges —
+    # probes only target non-DOWN members).  0 disables.
+    announce_down_period: float = 30.0
 
 
 @dataclass
@@ -87,6 +93,9 @@ class Swim:
         self._out: List[Tuple[Tuple[str, int], tuple]] = []
         self._events: List[Tuple[Actor, str]] = []
         self._next_probe_at = now + self.rng.uniform(0, self.config.probe_period)
+        self._next_announce_down_at = (
+            now + self.config.announce_down_period if self.config.announce_down_period > 0 else None
+        )
         self._probe_seq = 0
         # seq -> (target ActorId, direct_deadline, indirect_deadline, acked)
         self._probes: Dict[int, list] = {}
@@ -234,6 +243,23 @@ class Swim:
         if now >= self._next_probe_at:
             self._next_probe_at = now + self.config.probe_period
             self._probe_next(now)
+        # partition-heal announce: probes never target DOWN members, so a
+        # healed two-sided partition would otherwise stay split forever;
+        # periodically announce to one random DOWN member — if it answers,
+        # the direct contact revives it here and the "undead" notice makes
+        # it refute at a bumped incarnation that revives it cluster-wide
+        if (
+            self._next_announce_down_at is not None
+            and now >= self._next_announce_down_at
+        ):
+            self._next_announce_down_at = now + self.config.announce_down_period
+            downs = [m for m in self.members.values() if m.state == DOWN]
+            if downs:
+                target = self.rng.choice(downs)
+                self._emit(
+                    target.actor.addr,
+                    ("announce", actor_to_obj(self.identity)),
+                )
 
     def _probe_next(self, now: float) -> None:
         candidates = [m for m in self.members.values() if m.state != DOWN]
@@ -304,6 +330,8 @@ class Swim:
             or (actor.ts == entry.actor.ts and incarnation > entry.incarnation)
             or (direct and actor.ts >= entry.actor.ts and entry.state != ALIVE)
         ):
+            was_down = entry.state == DOWN
+            same_identity = actor.ts == entry.actor.ts
             was_down_or_suspect = entry.state != ALIVE
             if actor.ts > entry.actor.ts:
                 # renewed identity starts a fresh incarnation stream; keeping
@@ -318,6 +346,14 @@ class Swim:
             self._queue_update(actor, ALIVE, entry.incarnation)
             if was_down_or_suspect:
                 self._event(actor, "up")
+            if direct and was_down and same_identity:
+                # first-hand contact from a member we hold DOWN at its
+                # CURRENT identity: the revival above is local only (our
+                # gossiped ALIVE carries the same incarnation, which no
+                # other node accepts over DOWN) — tell the member so it
+                # refutes at a bumped incarnation that revives it
+                # everywhere (ref: foca's turn-undead notification)
+                self._emit(actor.addr, ("undead", actor_to_obj(self.identity)))
 
     def _observe_suspect(self, actor: Actor, incarnation: int, now: float) -> None:
         if actor.id == self.identity.id:
@@ -447,6 +483,14 @@ class Swim:
             for actor_obj in actors:
                 self._observe_alive(actor_from_obj(actor_obj), 0, now)
             self._apply_piggyback(pb, now)
+        elif kind == "undead":
+            # a peer held us DOWN and just noticed we're alive: refute
+            # loudly — the incarnation bump lets OUR alive-update overtake
+            # the stale DOWN entries on every node that gossip reaches
+            (_, from_obj) = msg
+            self._observe_alive(actor_from_obj(from_obj), 0, now, direct=True)
+            self.incarnation += 1
+            self._queue_update(self.identity, ALIVE, self.incarnation)
         elif kind == "leave":
             (_, from_obj) = msg
             actor = actor_from_obj(from_obj)

@@ -60,6 +60,7 @@ def load() -> ctypes.CDLL:
             ctypes.c_int,  # max_piggyback
             ctypes.c_int,  # update_retransmits
             ctypes.c_double,  # remove_down_after
+            ctypes.c_double,  # announce_down_period
             ctypes.c_uint64,  # seed
             ctypes.c_double,  # now
         ]
@@ -137,6 +138,7 @@ class NativeSwim:
             cfg.max_piggyback,
             cfg.update_retransmits,
             cfg.remove_down_after,
+            cfg.announce_down_period,
             seed,
             now,
         )

@@ -271,6 +271,10 @@ struct Config {
   int max_piggyback = 8;
   int update_retransmits = 6;
   double remove_down_after = 48 * 3600.0;
+  // partition-heal: period of announces to one random DOWN member (probes
+  // never target DOWN entries, so a healed partition would otherwise stay
+  // split forever); 0 disables.  Mirrors swim/core.py.
+  double announce_down_period = 30.0;
 };
 
 struct MemberEntry {
@@ -312,6 +316,9 @@ class Core {
       : identity_(std::move(identity)), cfg_(cfg), rng_(seed) {
     std::uniform_real_distribution<double> jitter(0.0, cfg_.probe_period);
     next_probe_at_ = now + jitter(rng_);
+    next_announce_down_at_ = cfg_.announce_down_period > 0
+                                 ? now + cfg_.announce_down_period
+                                 : -1.0;
   }
 
   Actor identity_;
@@ -417,6 +424,21 @@ class Core {
       next_probe_at_ = now + cfg_.probe_period;
       probe_next(now);
     }
+    // partition-heal announce to one random DOWN member (see Config)
+    if (next_announce_down_at_ >= 0 && now >= next_announce_down_at_) {
+      next_announce_down_at_ = now + cfg_.announce_down_period;
+      std::vector<MemberEntry*> downs;
+      for (auto& [id, m] : members_)
+        if (m.state == DOWN) downs.push_back(&m);
+      if (!downs.empty()) {
+        std::uniform_int_distribution<size_t> pick(0, downs.size() - 1);
+        MemberEntry* t = downs[pick(rng_)];
+        mp::ValueVec msg;
+        msg.push_back(mp::Value::str("announce"));
+        msg.push_back(identity_.to_obj());
+        emit(t->actor.host, t->actor.port, std::move(msg));
+      }
+    }
   }
 
   // -- message handling ---------------------------------------------------
@@ -513,6 +535,15 @@ class Core {
         }
       }
       apply_piggyback(m[4], now);
+    } else if (kind == "undead" && m.size() >= 3) {
+      // a peer held us DOWN and just noticed we're alive: refute at a
+      // bumped incarnation so OUR alive-update overtakes the stale DOWN
+      // entries everywhere gossip reaches (mirrors swim/core.py)
+      Actor sender;
+      if (!Actor::from_obj(m[2], sender)) return;
+      observe_alive(sender, 0, now, /*direct=*/true);
+      incarnation_ += 1;
+      queue_update(identity_, ALIVE, incarnation_);
     } else if (kind == "leave" && m.size() >= 3) {
       Actor actor;
       if (!Actor::from_obj(m[2], actor)) return;
@@ -588,6 +619,7 @@ class Core {
   std::vector<std::string> probe_queue_;
   uint64_t probe_seq_ = 0;
   double next_probe_at_ = 0.0;
+  double next_announce_down_at_ = -1.0;
 
   void emit(const std::string& host, int64_t port, mp::ValueVec msg) {
     mp::ValueVec tagged;
@@ -684,6 +716,8 @@ class Core {
         direct && actor.ts >= entry.actor.ts && entry.state != ALIVE;
     if (newer_identity || higher_inc || direct_revive) {
       bool was_not_alive = entry.state != ALIVE;
+      bool was_down = entry.state == DOWN;
+      bool same_identity = actor.ts == entry.actor.ts;
       if (newer_identity)
         entry.incarnation = incarnation;  // fresh incarnation stream
       else
@@ -693,6 +727,15 @@ class Core {
       entry.state_since = now;
       queue_update(actor, ALIVE, entry.incarnation);
       if (was_not_alive) events_.push_back(Event{actor, "up"});
+      if (direct && was_down && same_identity) {
+        // first-hand contact from a member we hold DOWN at its current
+        // identity: local revival gossips at an incarnation nobody
+        // accepts over DOWN — tell the member so it refutes loudly
+        mp::ValueVec msg;
+        msg.push_back(mp::Value::str("undead"));
+        msg.push_back(identity_.to_obj());
+        emit(actor.host, actor.port, std::move(msg));
+      }
     }
   }
 
@@ -765,7 +808,7 @@ void* swim_new(const uint8_t* id16, const char* host, int64_t port,
                double probe_timeout, int num_indirect_probes,
                double suspicion_timeout, int max_piggyback,
                int update_retransmits, double remove_down_after,
-               uint64_t seed, double now) {
+               double announce_down_period, uint64_t seed, double now) {
   swim::Actor identity;
   identity.id.assign(reinterpret_cast<const char*>(id16), 16);
   identity.host = host;
@@ -780,6 +823,7 @@ void* swim_new(const uint8_t* id16, const char* host, int64_t port,
   cfg.max_piggyback = max_piggyback;
   cfg.update_retransmits = update_retransmits;
   cfg.remove_down_after = remove_down_after;
+  cfg.announce_down_period = announce_down_period;
   return new swim::Core(std::move(identity), cfg, seed, now);
 }
 

@@ -75,15 +75,18 @@ class GossipConfig:
     probe_period: float = 1.0
     probe_timeout: float = 0.5
     suspicion_timeout: float = 3.0
+    # partition-heal: period of announces to one random DOWN member (see
+    # swim/core.py SwimConfig.announce_down_period); 0 disables
+    announce_down_period: float = 30.0
     # SWIM core implementation: "native" (C++ sans-IO core, the default —
     # the foca-equivalent is a native component in the reference) or
     # "python" (the executable spec in swim/core.py); both speak the same
     # wire and interoperate in one cluster
     swim_impl: str = "native"
     # transport backend: "native" = the C++ epoll datagram+stream core
-    # (transport/native/, plaintext-only), "python" = asyncio sockets
-    # (required for TLS/mTLS).  Nodes of either impl interoperate — the
-    # wire format (magic byte + u32-BE frames) is identical.
+    # (transport/native/) or "python" = asyncio sockets; BOTH support
+    # TLS 1.3/mTLS and interoperate in one cluster — the wire format
+    # (magic byte + u32-BE frames) is identical.
     transport_impl: str = "native"
 
 

@@ -496,3 +496,124 @@ def test_round_counts_churn():
         n=16, k=8, mt=2, sync_interval=3, ppm=90_000, churn_rounds=3,
         down=3, n_trials=24,
     )
+
+
+# -- partition mode: two-sided split + heal against the real runtime -------
+#
+# BASELINE config 5 is DEFINED by partition dynamics: a side split forms,
+# each side's real SWIM suspects and downs the other, writes keep landing
+# on both sides, and after the heal the membership re-merges (periodic
+# announce-to-down + undead-refute, swim/core.py) and anti-entropy closes
+# the data gap.  The harness realizes the sim's step-7 partition with a
+# sender-side frame filter (DevCluster.set_partition) over the REAL
+# transports; everything else is the same round-paced stack as the churn
+# experiment.  PAIRED randomness: partition side assignment (TAG_PART)
+# and write origins (TAG_ORIGIN) replay the sim's exact hash draws per
+# seed, so the means differ only by the dynamics under test.
+
+from corrosion_tpu.sim.rng import TAG_PART  # noqa: E402
+
+
+def sim_partition_sides(p: SimParams):
+    return [
+        1 if py_below(1_000_000, p.seed, TAG_PART, n) < p.partition_frac_ppm
+        else 0
+        for n in range(p.n_nodes)
+    ]
+
+
+async def one_partition_trial(p: SimParams, names):
+    n = p.n_nodes
+    cluster = DevCluster(
+        star_topology(n)[0],
+        schema=SCHEMA,
+        seeded_actors=True,
+        config_tweaks={
+            "perf": {
+                "manual_pacing": True,
+                "manual_swim": True,
+                "flush_interval": 0.01,
+            },
+            "gossip": {
+                "max_transmissions": p.max_transmissions,
+                "swim_impl": "python",
+                "probe_period": 1.0,
+                "probe_timeout": PROBE_TIMEOUT,
+                "suspicion_timeout": SUSPICION_ROUNDS - 0.7,
+                # one announce-to-down per round: the real heal mechanism
+                # the sim abstracts as swim_rejoin_rounds
+                "announce_down_period": 1.0,
+            },
+        },
+    )
+    await cluster.start()
+    nodes = {name: cluster[name] for name in names}
+    cluster.seed_full_membership()
+    for i, name in enumerate(names):
+        _arm(nodes[name], p.seed, i)
+
+    rng = random.Random(7_000_000 + p.seed)  # sync-peer draws only
+    sides = sim_partition_sides(p)
+    assert 0 < sum(sides) < n, "degenerate partition draw"
+    expected_heads: dict = {}
+    try:
+        for origin in sim_origins(p):
+            node = nodes[names[origin]]
+            out = await make_broadcastable_changes(
+                node.agent,
+                [(
+                    "INSERT INTO tests (id,text) VALUES (?,?)",
+                    (next(_ids), "x" * 40),
+                )],
+            )
+            await node.broadcast.enqueue(out.changesets)
+            aid = node.agent.actor_id
+            expected_heads[aid] = expected_heads.get(aid, 0) + 1
+
+        cluster.set_partition(
+            {name: sides[i] for i, name in enumerate(names)}
+        )
+        for r in range(MAX_ROUNDS):
+            if r == p.partition_rounds:
+                cluster.heal_partition()
+            await cluster.step_round(
+                r, sync_interval=p.sync_interval, rng=rng, swim=True
+            )
+            if _converged(list(cluster.nodes.values()), expected_heads):
+                return r + 1
+        raise AssertionError(
+            f"partition trial seed={p.seed} did not converge in {MAX_ROUNDS}"
+        )
+    finally:
+        await cluster.stop()
+
+
+def test_round_counts_partition_heal():
+    """16 nodes split ~30/70 for 6 rounds, 8 changesets written at round 0
+    on both sides, budget 2, sync every 3: each side's real SWIM probes
+    must down the other side, post-heal membership must re-merge through
+    the announce-to-down + undead-refute machinery (no manual rejoin!),
+    and real anti-entropy must close the cross-side data gap — the regime
+    of BASELINE config 5."""
+    n, k = 16, 8
+    _, names = star_topology(n)
+    hr, sr = [], []
+    for seed in range(24):
+        p = SimParams(
+            n_nodes=n, n_changes=k, fanout=3, max_transmissions=2,
+            sync_interval=3, write_rounds=1, max_rounds=MAX_ROUNDS,
+            partition_frac_ppm=300_000, partition_rounds=6,
+            swim=True, swim_suspicion=True,
+            swim_suspicion_rounds=SUSPICION_ROUNDS,
+            fanout_per_change=True, seed=seed,
+        )
+        hr.append(asyncio.run(one_partition_trial(p, names)))
+        res = run_reference(p)
+        assert res.converged
+        sr.append(res.rounds)
+    mh, ms = statistics.mean(hr), statistics.mean(sr)
+    gap = abs(mh - ms) / ms
+    assert gap <= TOLERANCE, (
+        f"partition fidelity broken: harness mean={mh:.3f} ({hr}) vs "
+        f"sim mean={ms:.3f} ({sr}) — gap {gap*100:.2f}% > ±2%"
+    )

@@ -27,6 +27,8 @@ class DatagramNet:
         self.rng = random.Random(seed)
         self.nodes = {}
         self.partitioned = set()
+        self.sides = {}  # addr -> side; cross-side traffic drops when split
+        self.split = False
         self.events = []
         self._n = 0
 
@@ -59,6 +61,8 @@ class DatagramNet:
                     for dest, datagram in swim.take_datagrams():
                         moved = True
                         if dest in self.partitioned:
+                            continue
+                        if self.split and self.sides.get(addr) != self.sides.get(dest):
                             continue
                         target = self.nodes.get(dest)
                         if target is not None:
@@ -228,3 +232,44 @@ def test_malformed_datagrams_are_dropped(impls):
     good = pack(("swim", "ping", 1, list(actor_to_obj(b.identity)), []))
     a.handle_datagram(good[: len(good) // 2], 2.0)
     assert a.members[b.identity.id].state == ALIVE  # unharmed
+
+
+def test_two_sided_partition_heals_automatically(impls):
+    """A two-sided partition re-merges WITHOUT any operator action or
+    identity renewal: the periodic announce-to-down timer re-establishes
+    cross-side contact, and the 'undead' notice makes contacted members
+    refute at a bumped incarnation that overtakes the stale DOWN entries
+    via piggyback gossip (ref: foca's periodic announce + turn-undead —
+    the reference relies on these for partition recovery; probes alone
+    never target DOWN members)."""
+    cfg = SwimConfig(
+        probe_period=0.3,
+        probe_timeout=0.1,
+        suspicion_timeout=0.8,
+        announce_down_period=0.3,
+    )
+    net = DatagramNet(impls, cfg, seed=7)
+    nodes = [net.add(i) for i in range(1, 9)]
+    for n in nodes[1:]:
+        n.announce(nodes[0].identity.addr)
+    net.run(until=4.0)
+    for swim in nodes:
+        assert len(swim.up_members()) == 7
+    # split 5/3 and let each side declare the other DOWN
+    for i, swim in enumerate(nodes):
+        net.sides[swim.identity.addr] = 0 if i < 5 else 1
+    net.split = True
+    net.run(until=12.0, start=4.0)
+    for i, swim in enumerate(nodes):
+        for j, other in enumerate(nodes):
+            if i == j:
+                continue
+            want = ALIVE if (i < 5) == (j < 5) else DOWN
+            assert swim.members[other.identity.id].state == want, (i, j)
+    # heal: NO announce() calls, no rejoin — the timers must do it
+    net.split = False
+    net.run(until=24.0, start=12.0)
+    for i, swim in enumerate(nodes):
+        for j, other in enumerate(nodes):
+            if i != j:
+                assert swim.members[other.identity.id].state == ALIVE, (i, j)

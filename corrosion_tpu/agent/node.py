@@ -327,6 +327,16 @@ class Node:
             self._tasks.append(
                 asyncio.create_task(self._runtime_metrics_loop())
             )
+        # build identity (ref: corro_build_info, prometheus.md:8) — a
+        # constant-1 gauge whose labels carry the version
+        from .. import __version__
+        from ..utils.metrics import gauge
+
+        gauge(
+            "corro.build.info",
+            version=__version__,
+            actor=self.agent.actor_id.as_simple()[:8],
+        ).set(1)
         self._started = True
         return self
 
@@ -551,8 +561,15 @@ class Node:
         await self.agent.pool.write_call(_write)
 
     async def _metrics_loop(self) -> None:
-        """Periodic store/cluster gauges (ref: metrics_loop +
-        agent/metrics.rs:18-80: DB/WAL size, per-table row counts).
+        while True:
+            await asyncio.sleep(10.0)
+            await self.metrics_tick()
+
+    async def metrics_tick(self) -> None:
+        """One store/cluster gauge refresh (ref: metrics_loop +
+        agent/metrics.rs:18-80: DB/WAL size, per-table row counts,
+        per-table checksums).  Runs every 10 s from :meth:`_metrics_loop`;
+        exposed as a method so tests can force a tick.
 
         Gauges carry an ``actor`` label: the registry is process-global,
         and an in-process dev cluster would otherwise last-writer-win
@@ -562,86 +579,122 @@ class Node:
         from ..utils.metrics import gauge
 
         me = self.agent.actor_id.as_simple()[:8]
-        while True:
-            await asyncio.sleep(10.0)
-            try:
-                if self.members is not None:
-                    states = self.members.states.values()
-                    gauge("corro.members.up", actor=me).set(
-                        sum(1 for m in states if m.state == "up")
-                    )
-                    gauge("corro.members.total", actor=me).set(
-                        len(self.members.states)
-                    )
-                db_path = self.config.db.path
-                if db_path != ":memory:" and os.path.exists(db_path):
-                    gauge("corro.db.size.bytes", actor=me).set(
-                        os.path.getsize(db_path)
-                    )
-                    wal = db_path + "-wal"
-                    if os.path.exists(wal):
-                        gauge("corro.db.wal.size.bytes", actor=me).set(
-                            os.path.getsize(wal)
-                        )
-
-                def _table_counts(conn):
-                    tables = [
-                        r[0]
-                        for r in conn.execute(
-                            "SELECT name FROM sqlite_master WHERE type = "
-                            "'table' AND name NOT LIKE '__corro%' AND name "
-                            "NOT LIKE '%__crsql_%' AND name NOT LIKE "
-                            "'sqlite_%' AND name NOT LIKE 'crsql_%'"
-                        ).fetchall()
-                    ]
-                    return {
-                        t: conn.execute(
-                            f'SELECT COUNT(*) FROM "{t}"'
-                        ).fetchone()[0]
-                        for t in tables
-                    }
-
-                counts = await self.agent.pool.read_call(_table_counts)
-                for table, n in counts.items():
-                    gauge("corro.db.table.rows", table=table, actor=me).set(n)
-                # transport counters (ref: the per-connection QUIC gauges,
-                # transport.rs:235-419) — both impls expose stats()
-                if self.transport is not None and hasattr(
-                    self.transport, "stats"
-                ):
-                    for name, v in self.transport.stats().items():
-                        gauge(f"corro.transport.{name}", actor=me).set(v)
-                # channel/queue depths (ref: the instrumented bounded
-                # channels, corro-types/src/channel.rs:53-95)
-                if self.ingest is not None:
-                    gauge("corro.ingest.queue.depth", actor=me).set(
-                        self.ingest.queue.qsize()
-                    )
-                    gauge("corro.ingest.apply.in_flight", actor=me).set(
-                        len(self.ingest._apply_tasks)
-                    )
-                if self.broadcast is not None:
-                    gauge("corro.broadcast.pending", actor=me).set(
-                        len(self.broadcast.pending)
-                    )
-                    gauge("corro.broadcast.queue.depth", actor=me).set(
-                        self.broadcast._queue.qsize()
-                    )
-                pool = self.agent.pool
-                for pri, label in ((0, "high"), (1, "normal"), (2, "low")):
-                    gauge(
-                        "corro.pool.write.queue.depth",
-                        actor=me, priority=label,
-                    ).set(len(pool._waiters[pri]))
-                gauge("corro.pool.read.available", actor=me).set(
-                    pool._read_pool.qsize()
+        try:
+            if self.members is not None:
+                states = self.members.states.values()
+                gauge("corro.members.up", actor=me).set(
+                    sum(1 for m in states if m.state == "up")
                 )
-                if self.subs is not None:
-                    gauge("corro.subs.active", actor=me).set(
-                        len(self.subs.by_id)
+                gauge("corro.members.total", actor=me).set(
+                    len(self.members.states)
+                )
+            db_path = self.config.db.path
+            if db_path != ":memory:" and os.path.exists(db_path):
+                gauge("corro.db.size.bytes", actor=me).set(
+                    os.path.getsize(db_path)
+                )
+                wal = db_path + "-wal"
+                if os.path.exists(wal):
+                    gauge("corro.db.wal.size.bytes", actor=me).set(
+                        os.path.getsize(wal)
                     )
-            except Exception:
-                logger.debug("metrics loop tick failed", exc_info=True)
+
+            def _table_counts(conn):
+                tables = [
+                    r[0]
+                    for r in conn.execute(
+                        "SELECT name FROM sqlite_master WHERE type = "
+                        "'table' AND name NOT LIKE '__corro%' AND name "
+                        "NOT LIKE '%__crsql_%' AND name NOT LIKE "
+                        "'sqlite_%' AND name NOT LIKE 'crsql_%'"
+                    ).fetchall()
+                ]
+                return {
+                    t: conn.execute(
+                        f'SELECT COUNT(*) FROM "{t}"'
+                    ).fetchone()[0]
+                    for t in tables
+                }
+
+            counts = await self.agent.pool.read_call(_table_counts)
+            for table, n in counts.items():
+                gauge("corro.db.table.rows", table=table, actor=me).set(n)
+
+            def _table_checksums(conn):
+                # site-independent per-table content checksum over the
+                # CRDT change stream (ref: corro_db_table_checksum,
+                # doc/telemetry/prometheus.md:10): an order-independent
+                # SUM of a real per-row hash of (pk, col, col_version,
+                # value) — converged nodes agree on that set, so equal
+                # checksums across nodes ⇔ content agreement (a
+                # length-only or version-only digest would miss value
+                # divergence, the exact thing this gauge exists to
+                # surface).  db_version/site_id are per-node, excluded.
+                import hashlib
+
+                try:
+                    cur = conn.execute(
+                        'SELECT "table", pk, cid, col_version, val'
+                        " FROM crsql_changes"
+                    )
+                except Exception:
+                    return {}  # store without the CRDT extension
+                sums: dict = {}
+                for t, pk, cid, ver, val in cur:
+                    h = hashlib.blake2b(digest_size=8)
+                    h.update(bytes(pk))
+                    h.update(str(cid).encode())
+                    h.update(str(ver).encode())
+                    h.update(repr(val).encode())
+                    sums[t] = (
+                        sums.get(t, 0)
+                        + int.from_bytes(h.digest(), "big")
+                    ) % (1 << 53)
+                return sums
+
+            sums = await self.agent.pool.read_call(_table_checksums)
+            for table, cs in sums.items():
+                gauge(
+                    "corro.db.table.checksum", table=table, actor=me
+                ).set(cs)
+            # transport counters (ref: the per-connection QUIC gauges,
+            # transport.rs:235-419) — both impls expose stats()
+            if self.transport is not None and hasattr(
+                self.transport, "stats"
+            ):
+                for name, v in self.transport.stats().items():
+                    gauge(f"corro.transport.{name}", actor=me).set(v)
+            # channel/queue depths (ref: the instrumented bounded
+            # channels, corro-types/src/channel.rs:53-95)
+            if self.ingest is not None:
+                gauge("corro.ingest.queue.depth", actor=me).set(
+                    self.ingest.queue.qsize()
+                )
+                gauge("corro.ingest.apply.in_flight", actor=me).set(
+                    len(self.ingest._apply_tasks)
+                )
+            if self.broadcast is not None:
+                gauge("corro.broadcast.pending", actor=me).set(
+                    len(self.broadcast.pending)
+                )
+                gauge("corro.broadcast.queue.depth", actor=me).set(
+                    self.broadcast._queue.qsize()
+                )
+            pool = self.agent.pool
+            for pri, label in ((0, "high"), (1, "normal"), (2, "low")):
+                gauge(
+                    "corro.pool.write.queue.depth",
+                    actor=me, priority=label,
+                ).set(len(pool._waiters[pri]))
+            gauge("corro.pool.read.available", actor=me).set(
+                pool._read_pool.qsize()
+            )
+            if self.subs is not None:
+                gauge("corro.subs.active", actor=me).set(
+                    len(self.subs.by_id)
+                )
+        except Exception:
+            logger.debug("metrics loop tick failed", exc_info=True)
 
     async def _runtime_metrics_loop(self, interval: float = 1.0) -> None:
         """asyncio runtime health (ref: tokio-metrics RuntimeMonitor ->

@@ -18,15 +18,20 @@ import contextlib
 import os
 import sqlite3
 import tempfile
+import time
 from typing import AsyncIterator, Callable, Optional, TypeVar
 
 from ..crdt import connect
+from ..utils.metrics import histogram
 
 T = TypeVar("T")
 
 PRIORITY_HIGH = 0
 PRIORITY_NORMAL = 1
 PRIORITY_LOW = 2
+
+_PRI_LABEL = {PRIORITY_HIGH: "high", PRIORITY_NORMAL: "normal",
+              PRIORITY_LOW: "low"}
 
 
 class SplitPool:
@@ -136,8 +141,21 @@ class SplitPool:
         # read()'s finally ON THE EVENT LOOP — the conn can never re-enter
         # the pool while a thread is still executing on it
         async def _do() -> T:
+            # queue/execution latency histograms (ref: the documented
+            # corro_sqlite_pool_queue_seconds / _execution_seconds,
+            # doc/telemetry/prometheus.md:29-30)
+            t0 = time.perf_counter()
             async with self.read() as conn:
-                return await asyncio.to_thread(fn, conn)
+                t1 = time.perf_counter()
+                histogram(
+                    "corro.sqlite.pool.queue.seconds", kind="read"
+                ).observe(t1 - t0)
+                try:
+                    return await asyncio.to_thread(fn, conn)
+                finally:
+                    histogram(
+                        "corro.sqlite.pool.execution.seconds", kind="read"
+                    ).observe(time.perf_counter() - t1)
 
         inner = asyncio.ensure_future(_do())
         # a cancelled awaiter abandons the inner task: retrieve any late
@@ -182,8 +200,21 @@ class SplitPool:
         # shielded for the same reason as read_call — a cancelled awaiter
         # must not release the write permit while its thread still writes
         async def _do() -> T:
+            label = _PRI_LABEL.get(priority, "normal")
+            t0 = time.perf_counter()
             async with self.write(priority) as conn:
-                return await asyncio.to_thread(fn, conn)
+                t1 = time.perf_counter()
+                histogram(
+                    "corro.sqlite.pool.queue.seconds",
+                    kind="write", priority=label,
+                ).observe(t1 - t0)
+                try:
+                    return await asyncio.to_thread(fn, conn)
+                finally:
+                    histogram(
+                        "corro.sqlite.pool.execution.seconds",
+                        kind="write", priority=label,
+                    ).observe(time.perf_counter() - t1)
 
         inner = asyncio.ensure_future(_do())
         inner.add_done_callback(lambda t: t.cancelled() or t.exception())

@@ -27,9 +27,12 @@ Implemented surface:
   ``pg_catalog`` relations (the reference implements pg_type/pg_class/…
   as virtual tables, corro-pg/src/vtab/)
 
-SQL translation is intentionally light (``$N`` → ``?N`` and type-cast
-stripping): SQLite accepts the bulk of the PG dialect the reference's
-sqlparser pass emits.
+SQL translation runs on a real PG-dialect tokenizer + statement parser
+(pg/parser.py — the analog of the reference's sqlparser pass,
+corro-pg/src/lib.rs:30-60), and every error carries a proper SQLSTATE
+from the catalog in pg/sql_state.py (the analog of
+corro-pg/src/sql_state.rs) so drivers can branch on 42P01/23505/25P02/…
+instead of a blanket XX000.
 """
 
 from __future__ import annotations
@@ -39,11 +42,15 @@ import contextlib
 import logging
 import re
 import secrets
+import sqlite3
 import struct
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..agent import Agent, make_broadcastable_changes
+from . import parser as pgparser
+from . import sql_state
+from .sql_state import PgError, map_exception
 
 logger = logging.getLogger(__name__)
 
@@ -60,16 +67,6 @@ OID_INT4 = 23
 OID_TEXT = 25
 OID_FLOAT8 = 701
 
-_READ_PREFIXES = ("select", "values", "pragma", "explain")
-_WRITE_WORDS = frozenset(
-    ("insert", "update", "delete", "replace", "create", "drop", "alter")
-)
-
-
-class PgProtocolError(Exception):
-    pass
-
-
 _QUALIFIER_RE = re.compile(r"\b(pg_catalog|information_schema)\.")
 
 
@@ -82,16 +79,46 @@ def _rewrite_code(sql: str, fn) -> str:
     )
 
 
-def _catalog_query(conn, raw_sql: str, params: Tuple):
-    """Run one introspection query against a catalog DB freshly derived
-    from ``conn``'s schema (pg/catalog.py).  ``'name'::regclass`` casts
-    become pg_class oid lookups BEFORE generic cast-stripping, and the
-    ``pg_catalog.`` / ``information_schema.`` qualifiers drop away (the
-    catalog DB's tables carry the bare names).  Both rewrites are
-    quote-aware: the regclass pattern anchors on the cast token in CODE
-    position (the quoted name it consumes is part of the cast
-    expression), and the qualifier strip maps over CODE runs only."""
-    from .catalog import build_catalog
+def _cached_catalog(conn, cache: Optional[Dict[int, bytes]]):
+    """The catalog DB for ``conn``'s CURRENT schema.  Round-4 rebuilt it
+    from scratch per introspection query — O(full schema) per ``\\d``;
+    now the built catalog is serialized once per `PRAGMA schema_version`
+    generation and each query deserializes the blob (a memcpy) into a
+    fresh connection.  Any DDL bumps schema_version, so invalidation is
+    exact; per-connection SQL functions are re-registered after
+    deserialize (they don't serialize)."""
+    from .catalog import _register_pg_functions, build_catalog
+
+    if cache is None:
+        return build_catalog(conn)
+    version = conn.execute("PRAGMA schema_version").fetchone()[0]
+    blob = cache.get(version)
+    if blob is None:
+        cache.clear()
+        src = build_catalog(conn)
+        try:
+            blob = src.serialize()
+        finally:
+            src.close()
+        cache[version] = blob
+    cat = sqlite3.connect(":memory:")
+    cat.deserialize(blob)
+    _register_pg_functions(cat)
+    return cat
+
+
+def _catalog_query(
+    conn, raw_sql: str, params: Tuple, cache: Optional[Dict[int, bytes]] = None
+):
+    """Run one introspection query against the catalog DB for ``conn``'s
+    schema (pg/catalog.py, cached via :func:`_cached_catalog`).
+    ``'name'::regclass`` casts become pg_class oid lookups BEFORE generic
+    cast-stripping, and the ``pg_catalog.`` / ``information_schema.``
+    qualifiers drop away (the catalog DB's tables carry the bare names).
+    Both rewrites are quote-aware: the regclass pattern anchors on the
+    cast token in CODE position (the quoted name it consumes is part of
+    the cast expression), and the qualifier strip maps over CODE runs
+    only."""
 
     # regclass casts: rewrite only where the '::regclass' token sits in
     # code — scan runs, and only join a QUOTED run with a following CODE
@@ -122,7 +149,7 @@ def _catalog_query(conn, raw_sql: str, params: Tuple):
         i += 1
     sql = translate_sql("".join(parts))
     sql = _rewrite_code(sql, lambda seg: _QUALIFIER_RE.sub("", seg))
-    cat = build_catalog(conn)
+    cat = _cached_catalog(conn, cache)
     try:
         cur = cat.execute(sql, params)
         desc = [d[0] for d in cur.description] if cur.description else []
@@ -133,15 +160,6 @@ def _catalog_query(conn, raw_sql: str, params: Tuple):
 
 # -- SQL translation --------------------------------------------------------
 
-_PARAM_RE = re.compile(r"\$(\d+)")
-# one type word, optionally 'double precision'/'character varying' style
-# second words, size args, and array suffix — must NOT cross clause words
-_CAST_RE = re.compile(
-    r"::\s*[a-zA-Z_][a-zA-Z0-9_]*"
-    r"(?:\s+(?:precision|varying))?"
-    r"(?:\(\d+(?:\s*,\s*\d+)?\))?"
-    r"(?:\[\])?"
-)
 _PG_CATALOG_RE = re.compile(
     r"\b(pg_catalog\.|pg_type\b|pg_class\b|pg_namespace\b|pg_database\b|"
     r"pg_range\b|pg_attribute\b|pg_proc\b|information_schema\.)",
@@ -236,90 +254,23 @@ def strip_comments(sql: str) -> str:
 
 
 def translate_sql(sql: str) -> str:
-    """PG dialect → SQLite: ``$N`` params and ``::cast`` stripping,
-    applied only OUTSIDE string literals so data is never rewritten
-    (ref: corro-pg's sqlparser translation pass); comments are dropped."""
-    out: List[str] = []
-    for segment, kind in _scan(sql):
-        if kind == QUOTED:
-            out.append(segment)
-        elif kind == COMMENT:
-            out.append(" ")
-        else:
-            segment = _PARAM_RE.sub(lambda m: f"?{m.group(1)}", segment)
-            segment = _CAST_RE.sub("", segment)
-            out.append(segment)
-    return "".join(out)
+    """PG dialect → SQLite over the statement parser (pg/parser.py):
+    ``$N`` → ``?N``, ``::type`` casts dropped, ``ILIKE`` → ``LIKE``,
+    E-strings/dollar-strings → standard literals; string data is never
+    rewritten (ref: corro-pg's sqlparser translation pass)."""
+    return pgparser.translate(pgparser.parse_statement(sql))
 
 
 def split_statements(script: str) -> List[str]:
-    """Split a simple-query script on ``;`` outside quotes AND comments."""
-    out: List[str] = []
-    buf: List[str] = []
-    for text, kind in _scan(script):
-        if kind != CODE:
-            buf.append(text)
-            continue
-        while ";" in text:
-            part, _, text = text.partition(";")
-            buf.append(part)
-            stmt = "".join(buf).strip()
-            if stmt and strip_comments(stmt).strip():
-                out.append(stmt)
-            buf = []
-        buf.append(text)
-    stmt = "".join(buf).strip()
-    if stmt and strip_comments(stmt).strip():
-        out.append(stmt)
-    return out
+    """Split a simple-query script on top-level ``;`` (token-accurate —
+    quotes, dollar-strings, comments and parens can all contain ``;``)."""
+    return pgparser.split_statements(script)
 
 
 def classify(sql: str) -> str:
     """'read' | 'write' | 'begin' | 'commit' | 'rollback' | 'set' | 'show'."""
-    sql = strip_comments(sql)
-    head = sql.lstrip().split(None, 1)
-    word = head[0].lower() if head else ""
-    if word == "begin" or word == "start":
-        return "begin"
-    if word in ("commit", "end"):
-        return "commit"
-    if word == "rollback":
-        return "rollback"
-    if word in ("set", "reset"):
-        return "set"
-    if word == "show":
-        return "show"
-    if word == "with":
-        # 'WITH … INSERT/UPDATE/DELETE' is a write; find the first
-        # top-level keyword after the CTE list (string/paren aware)
-        return "write" if _with_is_write(sql) else "read"
-    if word in _READ_PREFIXES:
-        return "read"
-    return "write"
-
-
-def _with_is_write(sql: str) -> bool:
-    depth = 0
-    quote: Optional[str] = None
-    for m in re.finditer(r"'|\"|\(|\)|\b[a-zA-Z_]+\b", sql):
-        tok = m.group(0)
-        if quote is not None:
-            if tok == quote:
-                quote = None
-            continue
-        if tok in ("'", '"'):
-            quote = tok
-        elif tok == "(":
-            depth += 1
-        elif tok == ")":
-            depth = max(0, depth - 1)
-        elif depth == 0:
-            low = tok.lower()
-            if low in _WRITE_WORDS:
-                return True
-            if low in ("select", "values"):
-                return False
-    return False
+    kind = pgparser.parse_statement(sql).kind
+    return "read" if kind == "empty" else kind
 
 
 def command_tag(sql: str, rowcount: int) -> str:
@@ -471,9 +422,10 @@ class MessageWriter:
 
 @dataclass
 class Prepared:
-    sql: str  # translated
+    sql: str  # translated at Parse time
     raw_sql: str
     param_oids: List[int]
+    kind: str = "read"  # classification from Parse time
 
 
 @dataclass
@@ -519,6 +471,9 @@ class PgServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: set = set()
         self.port: Optional[int] = None
+        # serialized catalog DB per PRAGMA schema_version generation
+        # (see _cached_catalog)
+        self._catalog_cache: Dict[int, bytes] = {}
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._server = await asyncio.start_server(self._handle, host, port)
@@ -672,7 +627,13 @@ class PgServer:
         self, payload: bytes, out: MessageWriter, tx: TxState
     ) -> None:
         script = payload[:-1].decode()
-        statements = split_statements(script)
+        try:
+            statements = split_statements(script)
+        except PgError as e:
+            # a script that won't even tokenize (unterminated string,
+            # unbalanced parens) is a SQL error, not a connection crash
+            out.error(str(e), e.code)
+            return
         if not statements:
             out.empty_query()
             return
@@ -681,11 +642,21 @@ class PgServer:
         # Scripts carrying their own BEGIN/COMMIT/ROLLBACK manage the
         # transaction explicitly, so the implicit wrapper stays out of
         # their way (statements outside the explicit block autocommit).
+        def _kind_or_none(s):
+            # a statement that won't parse is no tx-control word; its own
+            # execution below raises and produces the ErrorResponse — a
+            # PgError here would escape the per-statement try and kill
+            # the connection
+            try:
+                return classify(s)
+            except PgError:
+                return None
+
         implicit = (
             not tx.active
             and len(statements) > 1
             and not any(
-                classify(s) in ("begin", "commit", "rollback")
+                _kind_or_none(s) in ("begin", "commit", "rollback")
                 for s in statements
             )
         )
@@ -702,7 +673,7 @@ class PgServer:
                 if tx.active:
                     tx.failed = True
                 failed = True
-                out.error(str(e))
+                out.error(*map_exception(e))
                 break  # simple protocol aborts the script on error
         if implicit and tx.active:
             writes, tx.writes = list(tx.writes), []
@@ -713,7 +684,7 @@ class PgServer:
                 except Exception as e:
                     # a commit-time error is a SQL error, not a protocol
                     # crash: the client gets ErrorResponse + ReadyForQuery
-                    out.error(str(e))
+                    out.error(*map_exception(e))
 
     async def _run_statement(
         self,
@@ -722,13 +693,22 @@ class PgServer:
         out: MessageWriter,
         tx: TxState,
         describe_rows: bool,
+        parsed: Optional["Prepared"] = None,
     ) -> None:
-        kind = classify(raw_sql)
-        sql = translate_sql(raw_sql)
+        if parsed is not None:
+            # extended protocol: Parse already tokenized and translated —
+            # a prepare-once/execute-many driver must not re-lex per
+            # Execute
+            kind, sql = parsed.kind, parsed.sql
+        else:
+            stmt = pgparser.parse_statement(raw_sql)
+            kind = "read" if stmt.kind == "empty" else stmt.kind
+            sql = pgparser.translate(stmt)
         if tx.active and tx.failed and kind not in ("commit", "rollback"):
-            raise PgProtocolError(
+            raise PgError(
                 "current transaction is aborted, commands ignored until "
-                "end of transaction block"
+                "end of transaction block",
+                sql_state.IN_FAILED_SQL_TRANSACTION,
             )
         if kind == "begin":
             tx.active, tx.failed = True, False
@@ -792,7 +772,9 @@ class PgServer:
             # SQLite schema, so psql/psycopg introspection sees actual
             # tables and columns
             desc, rows = await self.agent.pool.read_call(
-                lambda conn: _catalog_query(conn, raw_sql, params)
+                lambda conn: _catalog_query(
+                    conn, raw_sql, params, self._catalog_cache
+                )
             )
             if describe_rows:
                 out.row_description(self._column_oids(desc, rows))
@@ -862,11 +844,22 @@ class PgServer:
             struct.unpack("!I", rest[2 + i * 4 : 6 + i * 4])[0]
             for i in range(n_oids)
         ]
-        n_params = len(set(_PARAM_RE.findall(raw_sql)))
-        while len(oids) < n_params:
+        # parse NOW: malformed SQL must error at Parse time with a real
+        # SQLSTATE (drivers surface Parse-phase 42601 as a syntax error
+        # on prepare, not on execute)
+        try:
+            stmt = pgparser.parse_statement(raw_sql)
+            translated = pgparser.translate(stmt)
+        except PgError as e:
+            out.error(str(e), e.code)
+            return False
+        while len(oids) < stmt.n_params:
             oids.append(OID_TEXT)
         prepared[name] = Prepared(
-            sql=translate_sql(raw_sql), raw_sql=raw_sql, param_oids=oids
+            sql=translated,
+            raw_sql=raw_sql,
+            param_oids=oids,
+            kind="read" if stmt.kind == "empty" else stmt.kind,
         )
         out.parse_complete()
         return True
@@ -956,7 +949,7 @@ class PgServer:
         params: Optional[List[Any]],
         out: MessageWriter,
     ) -> None:
-        if classify(stmt.raw_sql) != "read":
+        if stmt.kind != "read":
             out.no_data()
             return
 
@@ -973,6 +966,7 @@ class PgServer:
                     conn,
                     f"SELECT * FROM ({stmt.raw_sql.rstrip(';')}) LIMIT 0",
                     bound,
+                    self._catalog_cache,
                 )[0]
 
             try:
@@ -1016,10 +1010,11 @@ class PgServer:
                 out,
                 tx,
                 describe_rows=False,
+                parsed=portal.prepared,
             )
         except Exception as e:
             if tx.active:
                 tx.failed = True
-            out.error(str(e))
+            out.error(*map_exception(e))
             return False
         return True

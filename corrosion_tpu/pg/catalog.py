@@ -17,6 +17,7 @@ user-object range) ordered by ``sqlite_master`` rowid, columns use
 
 from __future__ import annotations
 
+import re
 import sqlite3
 from typing import List, Tuple
 
@@ -112,6 +113,8 @@ CREATE TABLE pg_proc (
     oid INTEGER PRIMARY KEY, proname TEXT, pronamespace INTEGER,
     proowner INTEGER, prolang INTEGER, prorettype INTEGER,
     pronargs INTEGER, proargtypes TEXT, prosrc TEXT);
+CREATE TABLE pg_attrdef (
+    oid INTEGER PRIMARY KEY, adrelid INTEGER, adnum INTEGER, adbin TEXT);
 CREATE TABLE pg_description (
     objoid INTEGER, classoid INTEGER, objsubid INTEGER, description TEXT);
 CREATE TABLE pg_am (
@@ -266,6 +269,15 @@ def build_catalog(conn: sqlite3.Connection) -> sqlite3.Connection:
                     1 if default is not None else 0,
                 ),
             )
+            if default is not None:
+                # column default expression for psql's \d / pg_get_expr
+                # (adbin is the raw expression text; pg_get_expr returns
+                # it verbatim)
+                cat.execute(
+                    "INSERT INTO pg_attrdef (adrelid, adnum, adbin)"
+                    " VALUES (?,?,?)",
+                    (rel_oid, cid + 1, str(default)),
+                )
         rel_oid += 1
 
     _register_pg_functions(cat)
@@ -334,3 +346,15 @@ def _register_pg_functions(cat: sqlite3.Connection) -> None:
         "quote_ident", 1, lambda s: f'"{s}"', deterministic=True
     )
     cat.create_function("version", 0, lambda: "PostgreSQL 14.0 (corrosion-tpu)")
+    # SQLite's REGEXP operator resolves to this (PG's ~ / !~ translate to
+    # [NOT] REGEXP; psql's \d stream matches relnames with '^pg_toast')
+    cat.create_function(
+        "regexp",
+        2,
+        lambda pat, val: (
+            None
+            if val is None or pat is None
+            else (re.search(pat, str(val)) is not None)
+        ),
+        deterministic=True,
+    )

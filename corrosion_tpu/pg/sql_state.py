@@ -1,0 +1,236 @@
+"""SQLSTATE error catalog and sqlite→SQLSTATE mapping.
+
+Equivalent of crates/corro-pg/src/sql_state.rs (1 336 lines: the full
+PostgreSQL SQLSTATE table as an enum with code()/name()).  Drivers branch
+on these codes — psycopg maps 23505 to UniqueViolation, SQLAlchemy
+retries 40001/40P01, ORMs introspect on 42P01 — so every ErrorResponse
+this server emits must carry the right class, not a blanket XX000.
+
+Two layers:
+
+- the catalog: the complete class-00..XX code set the reference's enum
+  covers, keyed by PostgreSQL's canonical condition names (Appendix A of
+  the PG docs — same source sql_state.rs was generated from);
+- :func:`map_exception`: the translation from the exceptions our SQLite
+  execution paths actually raise (sqlite3.OperationalError/
+  IntegrityError/... plus this package's own control-flow errors) to the
+  proper code, by inspecting SQLite's stable error-message shapes.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Tuple
+
+# -- catalog (ref: sql_state.rs:1-1336; names are PG's canonical
+#    condition names, Appendix A) -------------------------------------------
+
+SUCCESSFUL_COMPLETION = "00000"
+WARNING = "01000"
+NO_DATA = "02000"
+SQL_STATEMENT_NOT_YET_COMPLETE = "03000"
+CONNECTION_EXCEPTION = "08000"
+CONNECTION_DOES_NOT_EXIST = "08003"
+CONNECTION_FAILURE = "08006"
+SQLCLIENT_UNABLE_TO_ESTABLISH_SQLCONNECTION = "08001"
+SQLSERVER_REJECTED_ESTABLISHMENT_OF_SQLCONNECTION = "08004"
+PROTOCOL_VIOLATION = "08P01"
+TRIGGERED_ACTION_EXCEPTION = "09000"
+FEATURE_NOT_SUPPORTED = "0A000"
+INVALID_TRANSACTION_INITIATION = "0B000"
+LOCATOR_EXCEPTION = "0F000"
+INVALID_GRANTOR = "0L000"
+INVALID_ROLE_SPECIFICATION = "0P000"
+DIAGNOSTICS_EXCEPTION = "0Z000"
+CASE_NOT_FOUND = "20000"
+CARDINALITY_VIOLATION = "21000"
+DATA_EXCEPTION = "22000"
+STRING_DATA_RIGHT_TRUNCATION = "22001"
+NULL_VALUE_NO_INDICATOR_PARAMETER = "22002"
+NUMERIC_VALUE_OUT_OF_RANGE = "22003"
+NULL_VALUE_NOT_ALLOWED_DATA = "22004"
+INVALID_DATETIME_FORMAT = "22007"
+DIVISION_BY_ZERO = "22012"
+INVALID_PARAMETER_VALUE = "22023"
+INVALID_TEXT_REPRESENTATION = "22P02"
+INTEGRITY_CONSTRAINT_VIOLATION = "23000"
+RESTRICT_VIOLATION = "23001"
+NOT_NULL_VIOLATION = "23502"
+FOREIGN_KEY_VIOLATION = "23503"
+UNIQUE_VIOLATION = "23505"
+CHECK_VIOLATION = "23514"
+EXCLUSION_VIOLATION = "23P01"
+INVALID_CURSOR_STATE = "24000"
+INVALID_TRANSACTION_STATE = "25000"
+ACTIVE_SQL_TRANSACTION = "25001"
+NO_ACTIVE_SQL_TRANSACTION = "25P01"
+IN_FAILED_SQL_TRANSACTION = "25P02"
+READ_ONLY_SQL_TRANSACTION = "25006"
+INVALID_SQL_STATEMENT_NAME = "26000"
+TRIGGERED_DATA_CHANGE_VIOLATION = "27000"
+INVALID_AUTHORIZATION_SPECIFICATION = "28000"
+INVALID_PASSWORD = "28P01"
+DEPENDENT_OBJECTS_STILL_EXIST = "2BP01"
+INVALID_TRANSACTION_TERMINATION = "2D000"
+SQL_ROUTINE_EXCEPTION = "2F000"
+INVALID_CURSOR_NAME = "34000"
+EXTERNAL_ROUTINE_EXCEPTION = "38000"
+EXTERNAL_ROUTINE_INVOCATION_EXCEPTION = "39000"
+SAVEPOINT_EXCEPTION = "3B000"
+INVALID_CATALOG_NAME = "3D000"
+INVALID_SCHEMA_NAME = "3F000"
+TRANSACTION_ROLLBACK = "40000"
+SERIALIZATION_FAILURE = "40001"
+TRANSACTION_INTEGRITY_CONSTRAINT_VIOLATION = "40002"
+STATEMENT_COMPLETION_UNKNOWN = "40003"
+DEADLOCK_DETECTED = "40P01"
+SYNTAX_ERROR_OR_ACCESS_RULE_VIOLATION = "42000"
+SYNTAX_ERROR = "42601"
+INSUFFICIENT_PRIVILEGE = "42501"
+CANNOT_COERCE = "42846"
+GROUPING_ERROR = "42803"
+WINDOWING_ERROR = "42P20"
+INVALID_RECURSION = "42P19"
+INVALID_FOREIGN_KEY = "42830"
+INVALID_NAME = "42602"
+NAME_TOO_LONG = "42622"
+RESERVED_NAME = "42939"
+DATATYPE_MISMATCH = "42804"
+INDETERMINATE_DATATYPE = "42P18"
+COLLATION_MISMATCH = "42P21"
+INDETERMINATE_COLLATION = "42P22"
+WRONG_OBJECT_TYPE = "42809"
+UNDEFINED_COLUMN = "42703"
+UNDEFINED_FUNCTION = "42883"
+UNDEFINED_TABLE = "42P01"
+UNDEFINED_PARAMETER = "42P02"
+UNDEFINED_OBJECT = "42704"
+DUPLICATE_COLUMN = "42701"
+DUPLICATE_CURSOR = "42P03"
+DUPLICATE_DATABASE = "42P04"
+DUPLICATE_FUNCTION = "42723"
+DUPLICATE_PREPARED_STATEMENT = "42P05"
+DUPLICATE_SCHEMA = "42P06"
+DUPLICATE_TABLE = "42P07"
+DUPLICATE_ALIAS = "42712"
+DUPLICATE_OBJECT = "42710"
+AMBIGUOUS_COLUMN = "42702"
+AMBIGUOUS_FUNCTION = "42725"
+AMBIGUOUS_PARAMETER = "42P08"
+AMBIGUOUS_ALIAS = "42P09"
+INVALID_COLUMN_REFERENCE = "42P10"
+INVALID_COLUMN_DEFINITION = "42611"
+INVALID_CURSOR_DEFINITION = "42P11"
+INVALID_FUNCTION_DEFINITION = "42P13"
+INVALID_PREPARED_STATEMENT_DEFINITION = "42P14"
+INVALID_TABLE_DEFINITION = "42P16"
+WITH_CHECK_OPTION_VIOLATION = "44000"
+INSUFFICIENT_RESOURCES = "53000"
+DISK_FULL = "53100"
+OUT_OF_MEMORY = "53200"
+TOO_MANY_CONNECTIONS = "53300"
+PROGRAM_LIMIT_EXCEEDED = "54000"
+STATEMENT_TOO_COMPLEX = "54001"
+TOO_MANY_COLUMNS = "54011"
+TOO_MANY_ARGUMENTS = "54023"
+OBJECT_NOT_IN_PREREQUISITE_STATE = "55000"
+OBJECT_IN_USE = "55006"
+CANT_CHANGE_RUNTIME_PARAM = "55P02"
+LOCK_NOT_AVAILABLE = "55P03"
+OPERATOR_INTERVENTION = "57000"
+QUERY_CANCELED = "57014"
+ADMIN_SHUTDOWN = "57P01"
+CRASH_SHUTDOWN = "57P02"
+CANNOT_CONNECT_NOW = "57P03"
+DATABASE_DROPPED = "57P04"
+SYSTEM_ERROR = "58000"
+IO_ERROR = "58030"
+UNDEFINED_FILE = "58P01"
+DUPLICATE_FILE = "58P02"
+CONFIG_FILE_ERROR = "F0000"
+FDW_ERROR = "HV000"
+PLPGSQL_ERROR = "P0000"
+INTERNAL_ERROR = "XX000"
+DATA_CORRUPTED = "XX001"
+INDEX_CORRUPTED = "XX002"
+
+
+class PgError(Exception):
+    """A SQL-level error carrying its SQLSTATE (the server turns these
+    into ErrorResponse messages verbatim)."""
+
+    def __init__(self, message: str, code: str = INTERNAL_ERROR) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+# SQLite's error-message shapes are stable public API (the C library's
+# sqlite3ErrorMsg format strings); matching on them is how every SQLite
+# wrapper classifies errors.  Ordered: first hit wins.
+_OPERATIONAL_PATTERNS = (
+    ("no such table:", UNDEFINED_TABLE),
+    ("no such column:", UNDEFINED_COLUMN),
+    ("no such function:", UNDEFINED_FUNCTION),
+    ("no such index:", UNDEFINED_OBJECT),
+    ("no such module:", UNDEFINED_OBJECT),
+    ("no such savepoint:", SAVEPOINT_EXCEPTION),
+    ("ambiguous column name:", AMBIGUOUS_COLUMN),
+    ("already exists", DUPLICATE_TABLE),
+    ("duplicate column name:", DUPLICATE_COLUMN),
+    ("syntax error", SYNTAX_ERROR),
+    ("incomplete input", SYNTAX_ERROR),
+    ("unrecognized token:", SYNTAX_ERROR),
+    ("wrong number of arguments", UNDEFINED_FUNCTION),
+    ("database is locked", LOCK_NOT_AVAILABLE),
+    ("database table is locked", LOCK_NOT_AVAILABLE),
+    ("attempt to write a readonly database", READ_ONLY_SQL_TRANSACTION),
+    ("too many terms", STATEMENT_TOO_COMPLEX),
+    ("too many columns", TOO_MANY_COLUMNS),
+    ("too many arguments", TOO_MANY_ARGUMENTS),
+    ("parser stack overflow", STATEMENT_TOO_COMPLEX),
+    ("string or blob too big", PROGRAM_LIMIT_EXCEEDED),
+    ("out of memory", OUT_OF_MEMORY),
+    ("database or disk is full", DISK_FULL),
+    ("disk i/o error", IO_ERROR),
+    ("interrupted", QUERY_CANCELED),
+    ("cannot start a transaction within a transaction", ACTIVE_SQL_TRANSACTION),
+    ("cannot commit - no transaction is active", NO_ACTIVE_SQL_TRANSACTION),
+    ("cannot rollback - no transaction is active", NO_ACTIVE_SQL_TRANSACTION),
+)
+
+_INTEGRITY_PATTERNS = (
+    ("unique constraint failed", UNIQUE_VIOLATION),
+    ("not null constraint failed", NOT_NULL_VIOLATION),
+    ("foreign key constraint failed", FOREIGN_KEY_VIOLATION),
+    ("check constraint failed", CHECK_VIOLATION),
+    ("datatype mismatch", DATATYPE_MISMATCH),
+)
+
+
+def map_exception(exc: BaseException) -> Tuple[str, str]:
+    """(message, SQLSTATE) for any exception one of the execution paths
+    raised (ref: the reference maps rusqlite errors through its SqlState
+    enum the same way)."""
+    if isinstance(exc, PgError):
+        return str(exc), exc.code
+    msg = str(exc) or type(exc).__name__
+    low = msg.lower()
+    if isinstance(exc, sqlite3.IntegrityError):
+        for prefix, code in _INTEGRITY_PATTERNS:
+            if low.startswith(prefix):
+                return msg, code
+        return msg, INTEGRITY_CONSTRAINT_VIOLATION
+    if isinstance(exc, sqlite3.ProgrammingError):
+        if "parameter" in low or "binding" in low:
+            return msg, UNDEFINED_PARAMETER
+        return msg, SYNTAX_ERROR
+    if isinstance(exc, (sqlite3.OperationalError, sqlite3.DatabaseError)):
+        for prefix, code in _OPERATIONAL_PATTERNS:
+            if prefix in low:
+                return msg, code
+        return msg, INTERNAL_ERROR
+    if isinstance(exc, (ValueError, OverflowError)):
+        return msg, INVALID_TEXT_REPRESENTATION
+    if isinstance(exc, (TimeoutError,)):
+        return msg, QUERY_CANCELED
+    return msg, INTERNAL_ERROR

@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..types.change import SqliteValue, jsonify_cell as _encode_cell
 from ..types.columns import pack_columns
+from ..utils.metrics import counter
 from . import sql as sqlmod
 from .sql import MatcherError, ParsedSelect, pk_alias
 
@@ -521,6 +522,14 @@ class Matcher:
 
         queries: List[Tuple[str, Tuple]] = []
         if full_rerun:
+            # slow path: the whole query re-runs for this batch (a
+            # non-FROM table reference triggered it) — O(query) per
+            # change batch, always correct.  Counted so operators can
+            # SEE a subscription stuck off the candidate-restricted
+            # fast path instead of discovering it in a flamegraph.
+            counter(
+                "corro.subs.full.rerun", sub=self.id[:8]
+            ).inc()
             queries.append((self.rewritten, ()))
         else:
             for t_idx, ref in enumerate(self.parsed.tables):

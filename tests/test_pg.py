@@ -632,3 +632,207 @@ def test_node_config_starts_pg(tmp_path):
             await node.stop()
 
     run(main())
+
+
+def test_sqlstate_error_codes():
+    """Every error class carries its real SQLSTATE (ref:
+    corro-pg/src/sql_state.rs — drivers branch on these codes, e.g.
+    psycopg maps 23505 to UniqueViolation and 42P01 to UndefinedTable)."""
+
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+
+        async def code_of(sql):
+            _, _, _, errors, _ = await pg.query(sql)
+            assert errors, f"expected an error from {sql!r}"
+            return errors[0]["C"]
+
+        assert await code_of("SELECT * FROM no_such_relation") == "42P01"
+        assert await code_of("SELECT no_such_col FROM tests") == "42703"
+        assert await code_of("SELECT nope_fn(1) FROM tests") == "42883"
+        assert await code_of("SELECT * FROM tests WHERE (") == "42601"
+        assert await code_of("FLARB 1") == "42601"
+        await pg.query("INSERT INTO tests (id, text) VALUES (77, 'a')")
+        assert (
+            await code_of("INSERT INTO tests (id, text) VALUES (77, 'b')")
+            == "23505"
+        )
+        assert (
+            await code_of("INSERT INTO tests (id, text) VALUES (78, NULL)")
+            == "23502"
+        )
+        # aborted transaction: anything but COMMIT/ROLLBACK gets 25P02
+        await pg.query("BEGIN")
+        await pg.query("SELECT * FROM no_such_relation")
+        assert await code_of("SELECT 1") == "25P02"
+        await pg.query("ROLLBACK")
+
+        # extended protocol: syntax errors surface AT PARSE TIME
+        _, _, _, errors, _ = await pg.extended("SELECT 'unterminated")
+        assert errors and errors[0]["C"] == "42601"
+
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_pg_dialect_forms():
+    """Dollar-quoting, E-strings, ILIKE and multi-word casts — the
+    dialect forms real drivers emit — translate correctly with string
+    data round-tripping byte-exact (pg/parser.py)."""
+    assert (
+        translate_sql("SELECT x::timestamp with time zone FROM t")
+        == "SELECT x FROM t"
+    )
+    assert translate_sql("SELECT a ILIKE 'x%' FROM t") == (
+        "SELECT a LIKE 'x%' FROM t"
+    )
+    assert translate_sql("SELECT $tag$a;b'c$tag$") == "SELECT 'a;b''c'"
+    assert translate_sql(r"SELECT E'a\nb'") == "SELECT 'a\nb'"
+    # ';' inside dollar-quotes must not split
+    assert split_statements("SELECT $$x;y$$; SELECT 2") == [
+        "SELECT $$x;y$$",
+        "SELECT 2",
+    ]
+
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+        _, rows, _, errors, _ = await pg.query("SELECT $q$it's; fine$q$")
+        assert not errors and rows == [["it's; fine"]]
+        _, rows, _, errors, _ = await pg.query(r"SELECT E'tab\there'")
+        assert not errors and rows == [["tab\there"]]
+        _, rows, _, errors, _ = await pg.query(
+            "SELECT text FROM tests WHERE text ILIKE 'nomatch%'"
+        )
+        assert not errors and rows == []
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_catalog_cache_reuse_and_invalidation():
+    """The catalog DB is serialized once per schema generation and
+    reused across introspection queries; any DDL bumps
+    PRAGMA schema_version, so the next introspection sees the new table
+    (round-4 rebuilt the catalog from scratch per query)."""
+
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+        q = (
+            "SELECT c.relname FROM pg_catalog.pg_class c "
+            "WHERE c.relkind = 'r' ORDER BY c.relname"
+        )
+        _, rows, _, errors, _ = await pg.query(q)
+        assert not errors and ["tests"] in rows
+        assert len(server._catalog_cache) == 1
+        blob0 = next(iter(server._catalog_cache.values()))
+        _, rows, _, _, _ = await pg.query(q)
+        assert next(iter(server._catalog_cache.values())) is blob0  # reused
+        # DDL through the same server invalidates by schema_version
+        _, _, tags, errors, _ = await pg.query(
+            "CREATE TABLE extra (id INTEGER NOT NULL PRIMARY KEY, "
+            "v TEXT NOT NULL DEFAULT '') WITHOUT ROWID"
+        )
+        assert not errors, errors
+        _, rows, _, errors, _ = await pg.query(q)
+        assert not errors and ["extra"] in rows and ["tests"] in rows
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_psql_describe_stream():
+    """A captured psql 14 `\\dt` + `\\d tests` statement stream — the
+    exact SQL psql sends — runs end-to-end (ref: corro-pg README demo
+    drives psql against the reference)."""
+
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+        # \dt (psql 14 verbatim, minus access-method join)
+        _, rows, _, errors, _ = await pg.query(
+            "SELECT n.nspname as \"Schema\",\n"
+            "  c.relname as \"Name\",\n"
+            "  CASE c.relkind WHEN 'r' THEN 'table' WHEN 'v' THEN 'view'"
+            " WHEN 'm' THEN 'materialized view' WHEN 'i' THEN 'index'"
+            " WHEN 'S' THEN 'sequence' WHEN 's' THEN 'special'"
+            " WHEN 'p' THEN 'partitioned table' END as \"Type\",\n"
+            "  pg_catalog.pg_get_userbyid(c.relowner) as \"Owner\"\n"
+            "FROM pg_catalog.pg_class c\n"
+            "     LEFT JOIN pg_catalog.pg_namespace n ON n.oid = "
+            "c.relnamespace\n"
+            "WHERE c.relkind IN ('r','p','')\n"
+            "      AND n.nspname <> 'pg_catalog'\n"
+            "      AND n.nspname !~ '^pg_toast'\n"
+            "      AND n.nspname <> 'information_schema'\n"
+            "  AND pg_catalog.pg_table_is_visible(c.oid)\n"
+            "ORDER BY 1,2;"
+        )
+        assert not errors, errors
+        assert ["public", "tests", "table", "corrosion"] in rows
+
+        # \d tests step 1: resolve the relation oid (psql's ~ regex form)
+        _, rows, _, errors, _ = await pg.query(
+            "SELECT c.oid, n.nspname, c.relname FROM pg_catalog.pg_class c "
+            "LEFT JOIN pg_catalog.pg_namespace n ON n.oid = c.relnamespace "
+            "WHERE c.relname ~ '^(tests)$' "
+            "AND pg_catalog.pg_table_is_visible(c.oid) ORDER BY 2, 3;"
+        )
+        assert not errors, errors
+        oid = rows[0][0]
+        # \d tests step 2: the column query psql issues with that oid
+        _, rows, _, errors, _ = await pg.query(
+            "SELECT a.attname,\n"
+            "  pg_catalog.format_type(a.atttypid, a.atttypmod),\n"
+            "  (SELECT pg_catalog.pg_get_expr(d.adbin, d.adrelid, true)\n"
+            "   FROM pg_catalog.pg_attrdef d\n"
+            "   WHERE d.adrelid = a.attrelid AND d.adnum = a.attnum "
+            "AND a.atthasdef),\n"
+            "  a.attnotnull\n"
+            "FROM pg_catalog.pg_attribute a\n"
+            f"WHERE a.attrelid = '{oid}' AND a.attnum > 0 AND NOT "
+            "a.attisdropped\n"
+            "ORDER BY a.attnum;"
+        )
+        assert not errors, errors
+        assert [r[0] for r in rows] == ["id", "text"]
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_parenthesized_select_and_numbered_escapes():
+    """Regressions: '(SELECT 2)' is a valid PG read statement (it must
+    not kill the connection mid-script), and E-string hex/unicode/octal
+    escapes decode instead of silently dropping the backslash."""
+    from corrosion_tpu.pg import classify
+
+    assert classify("(SELECT 2)") == "read"
+    assert translate_sql(r"SELECT E'\x41'") == "SELECT 'A'"
+    assert translate_sql(r"SELECT E'A'") == "SELECT 'A'"
+    assert translate_sql(r"SELECT E'\101'") == "SELECT 'A'"
+
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+        _, rows, _, errors, _ = await pg.query("SELECT 1; (SELECT 2)")
+        assert not errors, errors
+        assert rows == [["1"], ["2"]]
+        _, rows, _, errors, _ = await pg.query(r"SELECT E'\x41B'")
+        assert not errors and rows == [["AB"]]
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())

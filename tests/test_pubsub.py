@@ -367,3 +367,57 @@ def test_subscription_restore(tmp_path):
         agent.close()
 
     run(main())
+
+
+def test_matcher_full_rerun_fallback_metric(tmp_path):
+    """A subscription referencing a table OUTSIDE its FROM clause (IN-
+    subquery) runs on the full-rerun slow path: results stay correct, and
+    the ``corro.subs.full.rerun`` counter exposes each slow-path batch so
+    operators can see a sub stuck off the candidate-restricted fast
+    path."""
+
+    async def main():
+        from corrosion_tpu.utils import metrics as metrics_mod
+
+        agent, subs = await boot(tmp_path)
+        await write(
+            agent, subs, "INSERT INTO tests (id, text) VALUES (1, 'one')"
+        )
+        await write(
+            agent, subs, "INSERT INTO buddies (id, buddy) VALUES (1, 'pal')"
+        )
+        matcher, _ = await subs.get_or_insert(
+            "SELECT id, text FROM tests "
+            "WHERE id IN (SELECT id FROM buddies WHERE buddy != '')"
+        )
+        await asyncio.wait_for(matcher.ready.wait(), 5)
+        assert "buddies" in matcher.full_rerun_tables
+        _, rows, _ = matcher.read_snapshot()
+        assert [json.loads(r[1]) for r in rows] == [[1, "one"]]
+
+        ctr = metrics_mod.counter(
+            "corro.subs.full.rerun", sub=matcher.id[:8]
+        )
+        before = ctr.value
+        sub = matcher.attach()
+        # a write to the NON-FROM table changes membership: only the
+        # slow path can see it
+        await write(
+            agent, subs, "INSERT INTO buddies (id, buddy) VALUES (2, 'p2')"
+        )
+        await write(
+            agent, subs, "INSERT INTO tests (id, text) VALUES (2, 'two')"
+        )
+        ev = await next_event(sub)
+        assert ev["change"][0] == "insert"
+        assert ev["change"][2] == [2, "two"]
+        assert ctr.value > before  # slow-path batches were counted
+        # retraction via the non-FROM table: delete the buddy row that
+        # qualifies id=2 — the row must retract through the slow path
+        await write(agent, subs, "DELETE FROM buddies WHERE id = 2")
+        ev = await next_event(sub)
+        assert ev["change"][0] == "delete"
+        await subs.stop()
+        agent.close()
+
+    run(main())

@@ -107,7 +107,11 @@ class Node:
         self.subs.start()
 
         self.members = Members(self.agent.actor_id)
-        self.sync_server = SyncServer(self.agent, cluster_id)
+        self.sync_server = SyncServer(
+            self.agent,
+            cluster_id,
+            max_permits=self.config.perf.max_concurrent_syncs,
+        )
         tls = self.config.gossip.tls
         if self.config.gossip.plaintext:
             tls = None

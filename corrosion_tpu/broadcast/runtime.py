@@ -57,6 +57,12 @@ class BroadcastRuntime:
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
         self._resend_task: Optional[asyncio.Task] = None
+        # round-paced experiments may install a per-payload target draw
+        # (``draw_hook(payload) -> Optional[List[addr]]``) that replaces
+        # the rng fanout sample — the fidelity harness uses it to replay
+        # the simulator's exact hash draws so harness and sim fan each
+        # payload out to the SAME targets per round (None falls back)
+        self.draw_hook = None
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._run())
@@ -109,11 +115,25 @@ class BroadcastRuntime:
         budgets (ref: broadcast/mod.rs:583-595)."""
         ups = sorted(self.members.up_members(), key=lambda m: bytes(m.actor.id))
         sends = []
-        if not ups:
+        if not ups and self.draw_hook is None:
             return sends
         for pb in pending:
-            sample = self.rng.sample(ups, min(NUM_INDIRECT_PROBES, len(ups)))
-            sends.extend((member.addr, pb.payload) for member in sample)
+            addrs = (
+                self.draw_hook(pb.payload)
+                if self.draw_hook is not None
+                else None
+            )
+            if addrs is not None:
+                sends.extend((a, pb.payload) for a in addrs)
+            elif ups:
+                sample = self.rng.sample(
+                    ups, min(NUM_INDIRECT_PROBES, len(ups))
+                )
+                sends.extend((member.addr, pb.payload) for member in sample)
+            # send_count advances even with no believed-up target: the
+            # sim decrements every pending chunk's budget per round
+            # unconditionally, and a frozen counter would grant extra
+            # transmissions after the view recovers
             pb.send_count += 1
             if pb.send_count >= self.max_transmissions:
                 self.pending.remove(pb)
@@ -166,8 +186,17 @@ class BroadcastRuntime:
         # desynchronize reproducible trials
         fresh.sort()
         for payload in fresh:
-            sends.extend(
-                (m.addr, payload) for m in self._initial_targets(payload)
+            addrs = (
+                self.draw_hook(payload) if self.draw_hook is not None else None
             )
+            if addrs is not None:
+                sends.extend((a, payload) for a in addrs)
+                self.pending.append(
+                    PendingBroadcast(payload=payload, send_count=1)
+                )
+            else:
+                sends.extend(
+                    (m.addr, payload) for m in self._initial_targets(payload)
+                )
         sends.extend(self._resend_tick(prior))
         return sends

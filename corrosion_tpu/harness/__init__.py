@@ -137,6 +137,8 @@ class DevCluster:
         # partition (sim/model.py step 7)
         self._part_sides: Dict[Tuple[str, int], int] = {}
         self._part_active = False
+        # killed nodes' ports, re-bound as placeholders until restart
+        self._parked_socks: Dict[str, tuple] = {}
 
     def _make_config(self, name: str):
         from ..types.config import Config
@@ -158,6 +160,14 @@ class DevCluster:
             target = getattr(cfg, section)
             for k, v in values.items():
                 setattr(target, k, v)
+        if cfg.perf.manual_pacing and "max_concurrent_syncs" not in (
+            self.config_tweaks.get("perf") or {}
+        ):
+            # round-paced sync handshakes every session before driving
+            # any (snapshot semantics); parked sessions would exhaust the
+            # real-time 3-permit default and busy-reject — a collision
+            # the jittered production sync loop never produces
+            cfg.perf.max_concurrent_syncs = len(self.topology.nodes)
         return cfg
 
     def _actor_id(self, name: str):
@@ -365,6 +375,11 @@ class DevCluster:
         for node in reversed(list(self.nodes.values())):
             await node.stop()
         self.nodes.clear()
+        for _, udp, tcp in self._parked_socks.values():
+            for s in (udp, tcp):
+                with contextlib.suppress(OSError):
+                    s.close()
+        self._parked_socks.clear()
 
     def __getitem__(self, name: str):
         return self.nodes[name]
@@ -411,9 +426,20 @@ class DevCluster:
         the harness realization of the sim's churn deaths (sim/model.py
         step 6).  The port stays reserved in ``self._ports`` for
         :meth:`restart`."""
+        from ..transport.net import bind_port_pair
+
         self._live_addrs.discard(("127.0.0.1", self._ports[name]))
         node = self.nodes.pop(name)
         await node.stop(crash=True)
+        # re-bind the freed port IMMEDIATELY as placeholders handed to
+        # restart(): during the down window an outbound connection from
+        # any other node could otherwise grab it as an EPHEMERAL source
+        # port, making the replacement's bind fail (observed in-suite).
+        # listen=False: peers' connects must be REFUSED, not queued for
+        # replay at the replacement
+        self._parked_socks[name] = bind_port_pair(
+            port=self._ports[name], listen=False
+        )
 
     async def restart(self, name: str) -> "Node":  # noqa: F821
         """Boot a replacement node on the killed node's address: same
@@ -425,7 +451,17 @@ class DevCluster:
         even over SUSPECT/DOWN entries for the old incarnation."""
         from ..transport.net import bind_port_pair
 
-        socks = bind_port_pair(port=self._ports[name])
+        socks = self._parked_socks.pop(name, None)
+        if socks is None:
+            socks = bind_port_pair(port=self._ports[name])
+        else:
+            _, udp, tcp = socks
+            # stale datagrams sent into the down window must die with the
+            # old incarnation, not replay at the replacement
+            with contextlib.suppress(BlockingIOError, OSError):
+                while True:
+                    udp.recvfrom(65536)
+            tcp.listen(128)
         self._live_addrs.add(("127.0.0.1", self._ports[name]))
         node = await self._boot_node(name, socks)
         self.nodes[name] = node
@@ -448,22 +484,27 @@ class DevCluster:
         and member registry (the sim starts from a fully-known cluster;
         python SWIM core only — the churn fidelity experiment pins
         ``swim_impl: python`` for seeded-rng reproducibility)."""
+        for node in self.nodes.values():
+            self.seed_node_membership(node, now=now)
+
+    def seed_node_membership(self, node, now: float = 0.0) -> None:
+        """Install complete ALIVE membership into ONE node (deterministic
+        sorted order), leaving every other node's views untouched — the
+        restart path: peers learn about the replacement from its announce
+        (direct revive / identity renewal), and their knowledge of OTHER
+        dead members must survive the restart (a full-cluster reseed
+        would erase accumulated DOWN state the failure detector paid
+        rounds to learn)."""
         from ..swim.core import ALIVE, MemberEntry
 
-        identities = {
-            name: node.swim.identity for name, node in self.nodes.items()
-        }
-        for node in self.nodes.values():
-            for other in identities.values():
-                if other.id == node.swim.identity.id:
-                    continue
-                node.swim.members[other.id] = MemberEntry(
-                    actor=other,
-                    state=ALIVE,
-                    incarnation=0,
-                    state_since=now,
-                )
-                node.members.add_member(other)
+        for name in sorted(self.nodes):
+            other = self.nodes[name].swim.identity
+            if other.id == node.swim.identity.id:
+                continue
+            node.swim.members[other.id] = MemberEntry(
+                actor=other, state=ALIVE, incarnation=0, state_since=now
+            )
+            node.members.add_member(other)
 
     async def _pump_datagrams(self, cycles: int = 3) -> None:
         """Drain multi-hop SWIM exchanges to completion.
@@ -547,7 +588,13 @@ class DevCluster:
                 quiet = 0
 
     async def step_round(
-        self, r: int, sync_interval: int = 0, rng=None, swim: bool = False
+        self,
+        r: int,
+        sync_interval: int = 0,
+        rng=None,
+        swim: bool = False,
+        sync_draw=None,
+        sync_attempts: int = 3,
     ) -> None:
         """Drive one round of the TPU simulator's round model
         (sim/model.py) through the REAL protocol stack: every node's
@@ -559,6 +606,7 @@ class DevCluster:
         ``swim=True`` prepends a round-paced SWIM probe round
         (:meth:`swim_phase`, perf.manual_swim) — the sim's step order:
         SWIM, broadcast, receive, sync (sim/model.py steps 2-5)."""
+        self.vround = r  # visible to draw hooks (broadcast pairing)
         if swim:
             await self.swim_phase(r)
         collected = [
@@ -586,17 +634,86 @@ class DevCluster:
         await self.settle()
         if sync_interval > 0 and (r + 1) % sync_interval == 0:
             rng = rng or _random.Random()
+            # sim-mirrored peer draw (sim/model.py step 5): a uniform pick
+            # over ALL other cluster slots with swim_probe_attempts
+            # redraws around believed-down members — a node whose 3 draws
+            # all land on down members syncs with NO ONE this round.
+            # Drawing from the up-list instead would silently give every
+            # node a guaranteed partner, a distribution the model doesn't
+            # have (at a 30% partition that's ~3% free syncs per node per
+            # sync round — measurably faster convergence).
+            # ``sync_draw(r, me, attempt) -> index`` overrides the pick;
+            # fidelity trials pass the sim's exact TAG_SYNC hash draw, so
+            # the harness and sim pull from the SAME peers per (round,
+            # node) — unpaired draw luck (e.g. pulling from a still-empty
+            # replacement) otherwise dominates the paired means on a
+            # sync-interval-quantized outcome.
+            all_names = self.topology.nodes
+            addr_to_name = {
+                ("127.0.0.1", self._ports[nm]): nm for nm in all_names
+            }
             jobs = []
             for node in self.nodes.values():
-                ups = sorted(
-                    node.members.up_members(),
-                    key=lambda m: bytes(m.actor.id),
+                by_addr = {
+                    (m.addr[0], m.addr[1]): m
+                    for m in node.members.up_members()
+                }
+                me = all_names.index(
+                    addr_to_name[(node.transport.host, node.transport.port)]
                 )
-                if not ups:
+                peer = None
+                for a in range(sync_attempts):  # sim: swim_probe_attempts
+                    if sync_draw is not None:
+                        t = sync_draw(r, me, a)
+                    else:
+                        t = rng.randrange(len(all_names) - 1)
+                        t = t + 1 if t >= me else t
+                    cand = by_addr.get(
+                        ("127.0.0.1", self._ports[all_names[t]])
+                    )
+                    if cand is not None:
+                        peer = cand
+                        break
+                if peer is None:
                     continue
-                peer = rng.choice(ups)
-                jobs.append(node.sync_with([(peer.actor.id, peer.addr)]))
-            await asyncio.gather(*jobs, return_exceptions=True)
+                jobs.append((node, peer))
+            # two-phase, snapshot-faithful, deterministic: phase A
+            # handshakes EVERY session first, so both ends exchange
+            # PRE-ROUND states and each client's request set is computed
+            # from pre-round needs; phase B then drives the sessions one
+            # by one.  Sequential single-phase syncs let node C pull data
+            # node A acquired seconds earlier IN THE SAME ROUND — an
+            # intra-round relay chain the sim's simultaneous-snapshot
+            # model (sim/model.py step 5) cannot express, measurably
+            # accelerating post-partition convergence; gathered syncs
+            # raced server states nondeterministically and tripped busy
+            # rejections.
+            from ..sync.session import drive_sessions, sync_handshake
+
+            sessions = []
+            for node, peer in jobs:
+                our_state = node.agent.generate_sync()
+                try:
+                    fs, their_state = await sync_handshake(
+                        node.agent,
+                        node.transport,
+                        peer.addr,
+                        node.config.gossip.cluster_id,
+                        our_state,
+                    )
+                except Exception:
+                    continue
+                if their_state is None:
+                    fs.close()
+                    continue
+                sessions.append(
+                    (node, our_state, (peer.actor.id, fs, their_state))
+                )
+            for node, our_state, sess in sessions:
+                with contextlib.suppress(Exception):
+                    await drive_sessions(
+                        node.agent, our_state, [sess], node.ingest.submit
+                    )
             await self.settle()
 
 

@@ -63,10 +63,15 @@ MAX_SESSION_REQ_ITEMS = 1000
 class SyncServer:
     """Answers inbound sync sessions for one node."""
 
-    def __init__(self, agent: Agent, cluster_id: int = 0) -> None:
+    def __init__(
+        self,
+        agent: Agent,
+        cluster_id: int = 0,
+        max_permits: int = MAX_CONCURRENT_SYNCS,
+    ) -> None:
         self.agent = agent
         self.cluster_id = cluster_id
-        self._permits = asyncio.Semaphore(MAX_CONCURRENT_SYNCS)
+        self._permits = asyncio.Semaphore(max_permits)
 
     async def serve(self, addr, fs: FramedStream) -> None:
         """ref: serve_sync, peer.rs:1308-1549"""
@@ -387,6 +392,48 @@ async def parallel_sync(
         )
 
 
+async def sync_handshake(
+    agent: Agent,
+    transport: Transport,
+    addr: Tuple[str, int],
+    cluster_id: int,
+    our_state: "SyncStateV1",
+):
+    """Open one sync session and exchange states; returns
+    ``(fs, their_state)`` with the stream left open for
+    :func:`drive_sessions`.  Split out of :func:`parallel_sync` so
+    round-paced callers can handshake EVERY session before driving any —
+    both ends' states are then pre-round snapshots, matching the sim's
+    simultaneous-snapshot sync semantics (sim/model.py step 5)."""
+    fs = await transport.open_bi(addr)
+    try:
+        # inject our trace so the server's spans join it (ref:
+        # traceparent injection at parallel_sync, peer.rs:937-940)
+        trace = {"traceparent": current_traceparent()}
+        await fs.send(
+            wire.encode_bi_sync_start(agent.actor_id, cluster_id, trace)
+        )
+        await fs.send(wire.encode_sync_state(our_state))
+        await fs.send(wire.encode_sync_clock(agent.clock.new_timestamp()))
+        their_state = None
+        for _ in range(2):
+            data = await fs.recv(timeout=HANDSHAKE_TIMEOUT)
+            if data is None:
+                raise ConnectionError("peer hung up during handshake")
+            kind, payload = wire.decode_sync(data)
+            if kind == "rejection":
+                raise ConnectionError(f"sync rejected: {payload}")
+            if kind == "state":
+                their_state = payload
+            elif kind == "clock":
+                with contextlib.suppress(ClockDriftError):
+                    agent.clock.update_with_timestamp(payload)
+        return fs, their_state
+    except BaseException:
+        fs.close()
+        raise
+
+
 async def _parallel_sync_traced(
     agent: Agent,
     transport: Transport,
@@ -396,38 +443,13 @@ async def _parallel_sync_traced(
 ) -> int:
     our_state = agent.generate_sync()
 
-    async def handshake(actor_id, addr):
-        fs = await transport.open_bi(addr)
-        try:
-            # inject our trace so the server's spans join it (ref:
-            # traceparent injection at parallel_sync, peer.rs:937-940)
-            trace = {"traceparent": current_traceparent()}
-            await fs.send(
-                wire.encode_bi_sync_start(agent.actor_id, cluster_id, trace)
-            )
-            await fs.send(wire.encode_sync_state(our_state))
-            await fs.send(wire.encode_sync_clock(agent.clock.new_timestamp()))
-            their_state = None
-            for _ in range(2):
-                data = await fs.recv(timeout=HANDSHAKE_TIMEOUT)
-                if data is None:
-                    raise ConnectionError("peer hung up during handshake")
-                kind, payload = wire.decode_sync(data)
-                if kind == "rejection":
-                    raise ConnectionError(f"sync rejected: {payload}")
-                if kind == "state":
-                    their_state = payload
-                elif kind == "clock":
-                    with contextlib.suppress(ClockDriftError):
-                        agent.clock.update_with_timestamp(payload)
-            return fs, their_state
-        except BaseException:
-            fs.close()
-            raise
-
     # 1. handshake with everyone concurrently
     handshakes = await asyncio.gather(
-        *(handshake(a, addr) for a, addr in peers), return_exceptions=True
+        *(
+            sync_handshake(agent, transport, addr, cluster_id, our_state)
+            for _a, addr in peers
+        ),
+        return_exceptions=True,
     )
     sessions = []
     for (actor_id, addr), hs in zip(peers, handshakes):
@@ -438,7 +460,18 @@ async def _parallel_sync_traced(
             fs.close()
             continue
         sessions.append((actor_id, fs, their_state))
+    return await drive_sessions(agent, our_state, sessions, submit)
 
+
+async def drive_sessions(
+    agent: Agent,
+    our_state: "SyncStateV1",
+    sessions,
+    submit: Callable[[ChangeV1, str], Awaitable[None]],
+) -> int:
+    """Allocate needs across handshaken sessions and drive them to
+    completion; ``sessions`` is ``[(actor_id, fs, their_state)]`` from
+    :func:`sync_handshake`."""
     # 2. allocate needs across peers, dedup via claimed range sets;
     # full-version spans are first chunked into ranges of ≤10 versions
     # (ref: peer.rs:1081 chunks(10)) so big catch-ups spread across peers
@@ -520,9 +553,11 @@ async def _parallel_sync_traced(
 
         writer = asyncio.create_task(write_requests())
         try:
+            eof = False
             while True:
                 data = await fs.recv(timeout=30.0)
                 if data is None:
+                    eof = True
                     break
                 kind, payload = wire.decode_sync(data)
                 if kind == "changeset":
@@ -533,6 +568,12 @@ async def _parallel_sync_traced(
                     await submit(payload, ChangeSource.SYNC)
                 elif kind in ("done", "rejection"):
                     break
+            if eof and not writer.done():
+                # EOF with requests still in flight: the send failure IS
+                # the story — cancelling it in finally would report a
+                # partially-failed sync as a normal count
+                with contextlib.suppress(asyncio.TimeoutError):
+                    await asyncio.wait_for(asyncio.shield(writer), 5.0)
             # surface writer failures (a dead conn mid-request) once the
             # response stream has drained
             if writer.done() and not writer.cancelled():

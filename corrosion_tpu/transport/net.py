@@ -28,7 +28,7 @@ from ..wire import WireError, deframe, frame
 Addr = Tuple[str, int]
 
 
-def bind_port_pair(host: str = "127.0.0.1", port: int = 0):
+def bind_port_pair(host: str = "127.0.0.1", port: int = 0, listen: bool = True):
     """Bind a UDP + TCP socket pair on one free port and hand them off.
 
     The dev-cluster harness must know every node's port before any node
@@ -40,6 +40,10 @@ def bind_port_pair(host: str = "127.0.0.1", port: int = 0):
 
     ``port``: bind that specific port instead of a free one (node restart
     on its previous address — harness churn mode); single attempt.
+    ``listen=False``: placeholder reservation only — TCP connects are
+    REFUSED while the pair parks a dead node's port (harness kill
+    windows), so senders observe a crashed peer, not a black hole that
+    replays frames at the replacement.
     """
     import socket as socketmod
 
@@ -60,7 +64,8 @@ def bind_port_pair(host: str = "127.0.0.1", port: int = 0):
         tcp.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_REUSEADDR, 1)
         try:
             tcp.bind((host, bound))
-            tcp.listen(128)
+            if listen:
+                tcp.listen(128)
         except OSError as e:
             udp.close()
             tcp.close()

@@ -118,6 +118,13 @@ class PerfConfig:
     # down → rejoin) runs round-synchronously against the sim's churn
     # model (sim/model.py step 2/6)
     manual_swim: bool = False
+    # Inbound sync-session permits per node (ref: the fixed 3-permit sync
+    # semaphore, agent.rs:131).  Round-paced experiments raise this to
+    # cluster size: they handshake every session before driving any (the
+    # sim's simultaneous-snapshot sync), which parks one open session per
+    # client on the servers — the real-time default would busy-reject
+    # them, a collision the jittered production sync loop never produces.
+    max_concurrent_syncs: int = 3
 
 
 @dataclass

@@ -123,12 +123,15 @@ class Agent:
     # -- change application ------------------------------------------------
 
     async def process_multiple_changes(
-        self, changes: Iterable[ChangeV1]
+        self,
+        changes: Iterable[ChangeV1],
+        no_bulk_keys: frozenset = frozenset(),
     ) -> apply_mod.ApplyResult:
         """Batch-apply incoming changesets (ref: util.rs:1128-1389): acquire
         per-actor booked write locks in deterministic order, run one write
         transaction, fold results into the in-memory ledgers, then flush any
-        partials that became gap-free."""
+        partials that became gap-free.  ``no_bulk_keys``: see
+        apply.process_changes_tx."""
         changes = list(changes)
         actor_ids = sorted({c.actor_id for c in changes})
         books: Dict[ActorId, Booked] = {
@@ -145,7 +148,10 @@ class Agent:
                 held.append(a)
             result = await self.pool.write_call(
                 lambda conn: apply_mod.process_changes_tx(
-                    conn, {a: books[a].versions for a in actor_ids}, changes
+                    conn,
+                    {a: books[a].versions for a in actor_ids},
+                    changes,
+                    no_bulk_keys=no_bulk_keys,
                 )
             )
             for actor, knowns in result.knowns.items():

@@ -172,16 +172,22 @@ def process_complete_version(
     conn: sqlite3.Connection,
     actor_id: ActorId,
     changeset: ChangesetFull,
+    allow_bulk: bool = True,
 ) -> Tuple[KnownDbVersion, Changeset]:
     """Merge a complete version's changes; returns the resulting known state
-    and the impactful changeset to rebroadcast (ref: util.rs:1514-1621)."""
+    and the impactful changeset to rebroadcast (ref: util.rs:1514-1621).
+
+    ``allow_bulk=False`` forces the per-row impact probe even for large
+    changesets: broadcast-sourced changesets feed their impactful subset
+    back into gossip, so exact tracking matters there; sync-sourced ones
+    are never rebroadcast and can take the fast path freely."""
     bump_db_version(conn)
     impactful: List[Change] = []
     last_impacted = conn.execute("SELECT crsql_rows_impacted()").fetchone()[0]
     ins = (
         f"INSERT INTO crsql_changes ({CHANGE_COLS}) VALUES (?,?,?,?,?,?,?,?,?)"
     )
-    if len(changeset.changes) >= BULK_APPLY_THRESHOLD:
+    if allow_bulk and len(changeset.changes) >= BULK_APPLY_THRESHOLD:
         # Large changesets (sync catch-up) skip the per-row impact probe:
         # one executemany + one rows_impacted read instead of 2·N Python
         # round-trips — the difference between the 65k-row catch-up
@@ -368,13 +374,16 @@ def process_changes_tx(
     conn: sqlite3.Connection,
     books: Dict[ActorId, BookedVersions],
     changes: Iterable[ChangeV1],
+    no_bulk_keys: frozenset = frozenset(),
 ) -> ApplyResult:
     """Apply a batch of changesets in ONE transaction (the write side of
     process_multiple_changes, util.rs:1128-1389).
 
     ``books`` are the in-memory ledgers of every actor involved; the caller
     must hold their write locks and fold the returned knowns back in after
-    commit.
+    commit.  ``no_bulk_keys``: ``(actor_id, versions)`` keys that must use
+    exact per-row impact tracking (broadcast-sourced changesets — see
+    process_complete_version).
     """
     result = ApplyResult(applied=[], knowns={}, ready_to_flush=[])
     conn.execute("BEGIN IMMEDIATE")
@@ -400,7 +409,12 @@ def process_changes_tx(
                 continue  # already have it
 
             if cs.is_complete():
-                known, new_cs = process_complete_version(conn, actor_id, cs)
+                known, new_cs = process_complete_version(
+                    conn,
+                    actor_id,
+                    cs,
+                    allow_bulk=(actor_id, versions) not in no_bulk_keys,
+                )
                 if isinstance(known, Cleared):
                     store_empty_changeset(conn, actor_id, versions)
                 else:

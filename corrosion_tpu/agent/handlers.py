@@ -157,7 +157,15 @@ class ChangeIngest:
         from ..types.clock import ntp64_to_unix_ns
 
         try:
-            result = await self.agent.process_multiple_changes(to_apply)
+            # broadcast-sourced changesets rebroadcast their impactful
+            # subset, so they keep exact per-row impact tracking; sync-
+            # sourced ones may take the bulk merge path (ADVICE r4)
+            no_bulk = frozenset(
+                (c.actor_id, c.changeset.versions) for c in to_rebroadcast
+            )
+            result = await self.agent.process_multiple_changes(
+                to_apply, no_bulk_keys=no_bulk
+            )
         except Exception:
             # failed batches must not kill the loop; drop seen-markers so the
             # changes can be retried via sync
@@ -179,6 +187,21 @@ class ChangeIngest:
                 lag = max(0.0, (now_ns - ntp64_to_unix_ns(ts)) / 1e9)
                 histogram("corro.changes.lag.seconds").observe(lag)
         if self.rebroadcast is not None and to_rebroadcast:
-            await self.rebroadcast(to_rebroadcast)
+            # rebroadcast the IMPACTFUL subset the merge computed, not
+            # the original payload (ref: util.rs:1552-1591 — the winning
+            # rows; losing LWW rows would waste gossip bandwidth
+            # cluster-wide).  result.applied carries (actor, changeset)
+            # post-merge; keep the broadcast-sourced ones, matched by
+            # version span (unchanged by subsetting).
+            bkeys = {
+                (c.actor_id, c.changeset.versions) for c in to_rebroadcast
+            }
+            subset = [
+                ChangeV1(actor_id=a, changeset=cs)
+                for a, cs in result.applied
+                if (a, cs.versions) in bkeys
+            ]
+            if subset:
+                await self.rebroadcast(subset)
         if self.notify is not None and result.applied:
             await self.notify(result.applied)

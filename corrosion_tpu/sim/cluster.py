@@ -489,12 +489,17 @@ def run(
     compiled = fn.lower(state).compile()
     t1 = time.perf_counter()
     out = jax.block_until_ready(compiled(state))
+    # scalar fetch INSIDE the timed region: on the axon TPU plugin
+    # block_until_ready can return before execution finishes, which made
+    # execute_s read as milliseconds while the next call absorbed the
+    # real 20+ s — a device-to-host transfer cannot complete early
+    rounds = int(out[-1])
     t2 = time.perf_counter()
-    cov, r = out[0], out[-1]
+    cov = out[0]
     converged = bool((cov == jnp.asarray(syncmod.full_masks(p))[None, :]).all())
     return SimResult(
         converged=converged,
-        rounds=int(r),
+        rounds=rounds,
         wall_s=t2 - t1,
         compile_s=t1 - t0,
         state=tuple(out) if return_state else None,
@@ -515,6 +520,7 @@ def run_trace(p: SimParams, n_rounds: Optional[int] = None) -> SimResult:
     out, counts = jax.block_until_ready(
         jax.jit(lambda s: lax.scan(body, s, None, length=n_rounds))(init_state(p))
     )
+    int(out[-1])  # scalar fetch: see the axon note in run()
     t1 = time.perf_counter()
     cov = out[0]
     total = p.n_nodes * p.n_changes

@@ -360,6 +360,12 @@ struct Transport {
     (void)n;
   }
 
+  // INVARIANT: enqueue_cmd and the counter reads (corro_tp_stats,
+  // queued_bytes) must remain NON-BLOCKING beyond this short mutex.
+  // Python drives them through PyDLL — the GIL is HELD across every
+  // call (transport/native/__init__.py) — so a bounded queue that
+  // waited here, or any other blocking wait, would stall the entire
+  // interpreter, not just the calling thread.
   void enqueue_cmd(Cmd &&cmd) {
     queued_add(cmd.data.size());
     {

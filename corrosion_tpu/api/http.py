@@ -77,6 +77,7 @@ class Api:
         authz_token: Optional[str] = None,
         subs=None,
         concurrency_limit: int = 128,
+        members_provider: Optional[Callable[[], list]] = None,
     ) -> None:
         self.agent = agent
         # called with the list of ChangeV1 produced by a local commit, so the
@@ -91,6 +92,9 @@ class Api:
         self._inflight = 0
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
+        # () -> list of member dicts; wired by the node runtime (a bare
+        # Api over an Agent has no cluster view)
+        self.members_provider = members_provider
 
     # -- app wiring -------------------------------------------------------
 
@@ -102,6 +106,7 @@ class Api:
         app.router.add_post("/v1/queries", self.query_handler)
         app.router.add_post("/v1/migrations", self.migrations_handler)
         app.router.add_post("/v1/table_stats", self.table_stats_handler)
+        app.router.add_get("/v1/members", self.members_handler)
         if self.subs is not None:
             from .subs import SubsApi
 
@@ -290,3 +295,12 @@ class Api:
 
         stats = await self.agent.pool.read_call(_stats)
         return web.json_response({"tables": stats})
+
+    async def members_handler(self, request: web.Request) -> web.Response:
+        """Cluster membership snapshot (ref: api_v1_members; the admin
+        socket's `cluster members` command exposes the same registry —
+        `cluster membership-states` is the RAW SWIM view instead)."""
+        provider = self.members_provider
+        return web.json_response(
+            {"members": provider() if provider is not None else []}
+        )

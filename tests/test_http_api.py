@@ -201,3 +201,43 @@ def test_schema_migration_add_column_and_reject_destructive():
         agent.close()
 
     run(main())
+
+
+def test_members_endpoint():
+    """GET /v1/members returns the node's live member registry (and [] on
+    a bare Api with no cluster view)."""
+
+    async def main():
+        agent, api, base = await boot()
+        async with ClientSession() as http:
+            r = await http.get(f"{base}/v1/members")
+            assert r.status == 200
+            assert await r.json() == {"members": []}
+        api_stop = api.stop()
+        await api_stop
+        agent.close()
+
+        # full node: membership visible over HTTP
+        import asyncio as aio
+        import time
+
+        from corrosion_tpu.harness import DevCluster, Topology
+
+        topo = Topology()
+        topo.add_edge("b", "a")
+        async with DevCluster(topo) as cluster:
+            t0 = time.monotonic()
+            while not all(
+                len(n.members.up_members()) == 1
+                for n in cluster.nodes.values()
+            ):
+                assert time.monotonic() - t0 < 30
+                await aio.sleep(0.1)
+            async with ClientSession() as http:
+                r = await http.get(cluster["a"].api_base + "/v1/members")
+                members = (await r.json())["members"]
+            assert len(members) == 1
+            assert members[0]["state"] == "up"
+            assert members[0]["address"].startswith("127.0.0.1:")
+
+    run(main())

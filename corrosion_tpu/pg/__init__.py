@@ -160,6 +160,18 @@ def _catalog_query(
 
 # -- SQL translation --------------------------------------------------------
 
+# the version() shim: served without touching SQLite (which has no such
+# function) — shared by _run_read (Execute) and _describe_rows (Describe)
+_VERSION_RE = re.compile(r"\s*select\s+version\s*\(\s*\)\s*;?\s*", re.I)
+
+
+def _show_param(raw_sql: str) -> str:
+    """The parameter name a SHOW statement asks for — shared by Describe
+    (column name) and Execute (lookup + column name) so the two can never
+    drift."""
+    return (raw_sql.split(None, 1)[1:] or [""])[0].strip().strip(";")
+
+
 _PG_CATALOG_RE = re.compile(
     r"\b(pg_catalog\.|pg_type\b|pg_class\b|pg_namespace\b|pg_database\b|"
     r"pg_range\b|pg_attribute\b|pg_proc\b|information_schema\.)",
@@ -732,7 +744,7 @@ class PgServer:
         elif kind == "show":
             # SHOW shim: canned session parameters (clients issue these at
             # connect; SQLAlchemy needs standard_conforming_strings)
-            param = (raw_sql.split(None, 1)[1:] or [""])[0].strip().strip(";")
+            param = _show_param(raw_sql)
             value = {
                 "server_version": "14.0 (corrosion-tpu)",
                 "standard_conforming_strings": "on",
@@ -782,7 +794,7 @@ class PgServer:
                 out.data_row(row)
             out.command_complete(command_tag(raw_sql, len(rows)))
             return
-        if re.fullmatch(r"\s*select\s+version\s*\(\s*\)\s*;?\s*", sql, re.I):
+        if _VERSION_RE.fullmatch(sql):
             if describe_rows:
                 out.row_description([("version", OID_TEXT)])
             out.data_row(["PostgreSQL 14.0 (corrosion-tpu)"])
@@ -911,9 +923,11 @@ class PgServer:
             struct.unpack("!H", rest[i * 2 : i * 2 + 2])[0]
             for i in range(n_rfmt)
         ]
-        if any(f == 1 for f in rfmts):
-            out.error("binary result format is not supported", "0A000")
-            return False
+        # binary result formats are accepted (psycopg3 requests binary by
+        # default): every extended-protocol RowDescription this server
+        # emits declares OID text, and the BINARY representation of a
+        # text-typed value is its utf-8 bytes — byte-identical to the
+        # text representation — so no separate encoder is needed
         portals[portal_name] = Portal(
             prepared=stmt, params=params, result_formats=rfmts
         )
@@ -949,8 +963,23 @@ class PgServer:
         params: Optional[List[Any]],
         out: MessageWriter,
     ) -> None:
+        if stmt.kind == "show":
+            # SHOW streams one DataRow at Execute; answering NoData here
+            # would make that row a protocol violation for extended-
+            # protocol clients (psycopg drives everything through
+            # Parse/Bind/Describe/Execute)
+            out.row_description(
+                [(_show_param(stmt.raw_sql) or "parameter", OID_TEXT)]
+            )
+            return
         if stmt.kind != "read":
             out.no_data()
+            return
+        if _VERSION_RE.fullmatch(stmt.sql):
+            # version() is shimmed at Execute (SQLite has no such
+            # function, so the LIMIT-0 probe below would answer NoData
+            # and the shimmed DataRow would violate the protocol)
+            out.row_description([("version", OID_TEXT)])
             return
 
         n = len(stmt.param_oids)

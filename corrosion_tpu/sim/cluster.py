@@ -95,8 +95,11 @@ def init_state(p: SimParams) -> SimState:
     # 747-773); a shared per-changeset budget measurably over-disseminates
     # (chunked-payload fidelity experiment, tests/test_sim_vs_harness.py)
     budget = jnp.zeros((p.n_nodes, p.n_changes, S), dtype=jnp.int8)
-    status = jnp.full((2, p.n_nodes), ALIVE, dtype=jnp.int8)
-    since = jnp.zeros((2, p.n_nodes), dtype=jnp.int32)
+    # membership views: [2, N] per-side consensus, or [N, N] per-node
+    # (model.py swim_per_node_views — viewer-major rows)
+    n_views = p.n_nodes if (p.swim and p.swim_per_node_views) else 2
+    status = jnp.full((n_views, p.n_nodes), ALIVE, dtype=jnp.int8)
+    since = jnp.zeros((n_views, p.n_nodes), dtype=jnp.int32)
     return cov, budget, status, since, jnp.int32(0)
 
 
@@ -228,6 +231,12 @@ def make_step(p: SimParams):
             t = jx_below(N - 1, p.seed, TAG_BCAST, r, narange, slot, *suffix)
         return t + (t >= narange)  # skip self
 
+    per_node = p.swim and p.swim_per_node_views
+    if per_node:
+        assert p.partition_frac_ppm == 0, (
+            "per-node views do not model partitions yet"
+        )
+
     def step(state: SimState) -> SimState:
         cov, budget, status, since, r = state
         alive = alive_at(r)
@@ -235,7 +244,10 @@ def make_step(p: SimParams):
         # effective partition side (all-zero once healed)
         part_active = r < p.partition_rounds
         pvec = jnp.where(part_active, part, jnp.int8(0))
-        view = part.astype(jnp.int32)  # static side label = viewer's view
+        # viewer selector for draw_excluding's down2[viewer, target]
+        # gather: the partition side label in consensus mode, the node's
+        # own index in per-node mode — the indexing code is identical
+        view = narange if per_node else part.astype(jnp.int32)
 
         # 1. inject this round's writes at their origins, full coverage
         inj = inject_round == r
@@ -246,15 +258,84 @@ def make_step(p: SimParams):
             jnp.where(inj, T8, jnp.int8(0))[:, None]
         )
 
-        # 2. SWIM probe / suspect / refute / rejoin (per-side views)
+        # 2. SWIM probe / suspect / refute / rejoin
         if p.swim:
-            down2 = status == DOWN  # [2, N] believed-down per side view
+            # shared by both view models — the probe draw keying must
+            # stay bit-identical between them (paired-randomness
+            # fidelity experiments replay these exact draws)
+            down2 = status == DOWN  # [2, N] per side, or [N, N] per node
 
             def probe_draw(a):
                 suffix = () if a == 0 else (a,)
                 t = jx_below(N - 1, p.seed, TAG_PROBE, r, narange, *suffix)
                 return t + (t >= narange)
 
+        if per_node:
+            # -- [N, N] per-node views (model.py swim_per_node_views);
+            # mirrors reference.py's scalar loop: probes from round-start
+            # views, stage-A expiry + own probe result, stage-B gossip
+            # merge along successful probe edges via order-independent
+            # max of encoded (since*3 + state) keys, then restart seeding
+            target, found = draw_excluding(down2, narange, probe_draw)
+            probing = jnp.logical_and(alive, found)
+            succ_edge = jnp.logical_and(probing, alive[target])
+            fail = jnp.logical_and(probing, jnp.logical_not(alive[target]))
+            # stage A: expiry on live viewers' rows
+            expire = jnp.logical_and(
+                status == SUSPECT, r - since >= p.swim_suspicion_rounds
+            )
+            expire = jnp.logical_and(expire, alive[:, None])
+            stA = jnp.where(expire, jnp.int8(DOWN), status)
+            sA = jnp.where(expire, r, since)
+            # own probe result at (v, target[v])
+            cur = stA[narange, target]
+            fail_to = jnp.int8(SUSPECT if p.swim_suspicion else DOWN)
+            new_st = jnp.where(
+                jnp.logical_and(succ_edge, cur != ALIVE),
+                jnp.int8(ALIVE),
+                jnp.where(jnp.logical_and(fail, cur == ALIVE), fail_to, cur),
+            )
+            changed = new_st != cur
+            stA = stA.at[narange, target].set(
+                jnp.where(probing, new_st, cur)
+            )
+            sA = sA.at[narange, target].set(
+                jnp.where(
+                    jnp.logical_and(probing, changed),
+                    r,
+                    sA[narange, target],
+                )
+            )
+            # stage B: key merge along edges, both directions
+            key = sA * 3 + stA.astype(jnp.int32)  # [N, N]
+            cols = narange[None, :]
+            # v adopts t's row (skip column v — self)
+            contrib_a = jnp.where(
+                jnp.logical_and(succ_edge[:, None], cols != narange[:, None]),
+                key[target],
+                jnp.int32(-1),
+            )
+            inc = jnp.maximum(key, contrib_a)
+            # t adopts v's row (skip column t — t's self); duplicate
+            # targets OR-combine through the scatter-max
+            contrib_b = jnp.where(
+                jnp.logical_and(succ_edge[:, None], cols != target[:, None]),
+                key,
+                jnp.int32(-1),
+            )
+            inc = inc.at[target].max(contrib_b)
+            status = (inc % 3).astype(jnp.int8)
+            since = inc // 3
+            # restarts: replacement row = exact current liveness; its
+            # announce reaches every live viewer this round
+            row_new = jnp.where(alive, jnp.int8(ALIVE), jnp.int8(DOWN))
+            status = jnp.where(restarted[:, None], row_new[None, :], status)
+            since = jnp.where(restarted[:, None], r, since)
+            ann_col = jnp.logical_and(alive[:, None], restarted[None, :])
+            status = jnp.where(ann_col, jnp.int8(ALIVE), status)
+            since = jnp.where(ann_col, r, since)
+            down2 = status == DOWN
+        elif p.swim:
             target, found = draw_excluding(down2, view, probe_draw)
             link_ok = pvec == pvec[target]
             probing = jnp.logical_and(alive, found)

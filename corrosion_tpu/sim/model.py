@@ -24,14 +24,19 @@ calls for.  Per round, in order:
    Identity::renew, actor.rs:199-210).  Membership views are tracked per
    partition side (each side independently suspects the other).
 
-   *Abstraction ceiling*: the two per-side views (``status[2, N]``) model
-   cluster-consensus membership, not real SWIM's one-view-per-node.
-   They cannot represent view asymmetry WITHIN a side, multi-way
-   partitions, or flapping links — sufficient for BASELINE configs 1-5
-   (two-sided partitions at most) and for the round-count fidelity bar
-   (tests/test_sim_vs_harness.py runs with static membership), but a
-   per-node ``[N, N]`` view tensor is the upgrade path if a future
-   fidelity experiment exercises failure detection itself.
+   *Two view models.*  The default ``status[2, N]`` per-side views model
+   cluster-consensus membership — sufficient for BASELINE configs 1-5
+   and exact on the 16-node churn fidelity experiment.
+   ``swim_per_node_views=True`` upgrades to the ``[N, N]`` per-node
+   tensor: every node keeps its own view, failure knowledge spreads
+   along successful probe edges (ping/ack piggyback) with
+   latest-observation-wins merges, and restarts seed the replacement
+   with exact current liveness — capturing the per-node detection skew
+   the consensus view cannot (at 48 nodes with overlapping suspicion
+   epochs it matches the real runtime seed-for-seed where consensus
+   diverges on one seed; both models hold the ±2% bar,
+   tests/test_sim_vs_harness.py).  Per-node views are O(N²) memory and
+   do not model partitions.
 3. *Broadcast*: every live node with budgeted chunks sends each held
    (changeset, chunk) payload to ``fanout`` targets it believes up.
    Two draw policies, both validated against the real agent runtime by
@@ -124,6 +129,15 @@ class SimParams:
     swim_suspicion_rounds: int = 3  # suspect rounds before declared down
     swim_probe_attempts: int = 3  # redraws around believed-down targets
     swim_rejoin_rounds: int = 2  # rounds before a down-marked live node re-announces
+    # per-node membership views (the [N, N] upgrade the abstraction-
+    # ceiling note above names): every node keeps its OWN view of every
+    # member; failure knowledge spreads along successful probe edges
+    # (ping/ack piggyback) with latest-observation-wins merges, and a
+    # restart seeds the replacement with exact current liveness (the
+    # harness's replacement-only seeding).  Memory is O(N²) — use for
+    # fidelity-scale configs; the [2, N] consensus view remains the
+    # default and the only mode supporting partitions.
+    swim_per_node_views: bool = False
     # seq-chunking + sync needs budget (steps 1/5 above)
     nseq_max: int = 1  # chunks per changeset in [1, nseq_max]; 1 = unchunked
     sync_chunk_budget: int = 0  # max chunks served per sync session; 0 = all

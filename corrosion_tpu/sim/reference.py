@@ -159,6 +159,14 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
     ]
     status: List[List[int]] = [[ALIVE] * N, [ALIVE] * N]
     since: List[List[int]] = [[0] * N, [0] * N]
+    per_node = p.swim and p.swim_per_node_views
+    if per_node:
+        assert p.partition_frac_ppm == 0, (
+            "per-node views do not model partitions yet"
+        )
+        # view[v][t] / vsince[v][t]: viewer v's belief about member t
+        view: List[List[int]] = [[ALIVE] * N for _ in range(N)]
+        vsince: List[List[int]] = [[0] * N for _ in range(N)]
     by_round = {}
     for k in range(K):
         by_round.setdefault(inject_round[k], []).append(k)
@@ -167,13 +175,17 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
         """First candidate over `attempts` redraws not believed down;
         returns the FIRST candidate when nothing was found (the JAX twin
         keeps its initial draw in that case — the value feeds the
-        distinct-fanout exclusion chain and must match bit-for-bit)."""
+        distinct-fanout exclusion chain and must match bit-for-bit).
+        Per-node mode consults the drawer's OWN view row."""
         first = None
         for a in range(attempts):
             t = draw(a)
             if first is None:
                 first = t
-            if status[my_view][t] != DOWN:
+            believed_down = (
+                view[n][t] == DOWN if per_node else status[my_view][t] == DOWN
+            )
+            if not believed_down:
                 return t, True
         return first, False
 
@@ -191,7 +203,72 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
                 budget[origin[k]][k][s] = max(budget[origin[k]][k][s], T)
 
         # 2. SWIM: probes against round-start views, then per-view updates
-        if p.swim:
+        if per_node:
+            # -- [N, N] per-node views (model.py swim_per_node_views) --
+            # probes from round-start views
+            probes = {}
+            for v in range(N):
+                if not alive[v]:
+                    continue
+                t, found = draw_excluding(
+                    v, lambda a, v=v: _probe_target(p, r, v, a), 0
+                )
+                if found:
+                    probes[v] = (t, alive[t])
+            # stage A: suspicion expiry + own probe results, per viewer
+            stA = [row[:] for row in view]
+            sA = [row[:] for row in vsince]
+            for v in range(N):
+                if not alive[v]:
+                    continue
+                for m in range(N):
+                    if (
+                        stA[v][m] == SUSPECT
+                        and r - sA[v][m] >= p.swim_suspicion_rounds
+                    ):
+                        stA[v][m], sA[v][m] = DOWN, r
+                pr = probes.get(v)
+                if pr is not None:
+                    t, ok = pr
+                    if ok and stA[v][t] != ALIVE:
+                        stA[v][t], sA[v][t] = ALIVE, r
+                    elif not ok and stA[v][t] == ALIVE:
+                        stA[v][t] = SUSPECT if p.swim_suspicion else DOWN
+                        sA[v][t] = r
+            # stage B: gossip along SUCCESSFUL probe edges (ping/ack
+            # piggyback, both directions) — latest-observation-wins via
+            # an encoded key (since*3 + state: greater since wins, ties
+            # go to the worse state); max-merges are order-independent
+            key = [
+                [sA[v][m] * 3 + stA[v][m] for m in range(N)]
+                for v in range(N)
+            ]
+            inc = [row[:] for row in key]
+            for v, (t, ok) in probes.items():
+                if not ok:
+                    continue
+                for m in range(N):
+                    if m != v and key[t][m] > inc[v][m]:
+                        inc[v][m] = key[t][m]
+                    if m != t and key[v][m] > inc[t][m]:
+                        inc[t][m] = key[v][m]
+            for v in range(N):
+                for m in range(N):
+                    view[v][m], vsince[v][m] = inc[v][m] % 3, inc[v][m] // 3
+            # restarts: the replacement row is seeded with EXACT current
+            # liveness (the harness's replacement-only seeding), and its
+            # announce reaches every live viewer this round
+            for t in range(N):
+                if not restarted[t]:
+                    continue
+                for m in range(N):
+                    view[t][m] = ALIVE if alive[m] else DOWN
+                    vsince[t][m] = r
+                view[t][t] = ALIVE
+                for v in range(N):
+                    if alive[v] and v != t:
+                        view[v][t], vsince[v][t] = ALIVE, r
+        elif p.swim:
             succ_v = [set(), set()]
             fail_v = [set(), set()]
             for n in range(N):
@@ -345,6 +422,6 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
     result.have = [
         {k for k in range(K) if cov[n][k] == full[k]} for n in range(N)
     ]
-    result.status = status
+    result.status = view if per_node else status
     result.budget = budget
     return result

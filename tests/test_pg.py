@@ -836,3 +836,64 @@ def test_parenthesized_select_and_numbered_escapes():
         agent.close()
 
     run(main())
+
+
+def test_binary_result_format_and_show_extended():
+    """psycopg3 requests BINARY result format by default: since every
+    extended-protocol RowDescription declares text OIDs, the binary
+    representation equals the text bytes and the server accepts the
+    request.  SHOW over the extended protocol must Describe a row (it
+    streams one at Execute)."""
+
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+
+        # Parse/Bind with result format = binary (1), Describe, Execute
+        sql = "SELECT id, text FROM tests"
+        await pg.query("INSERT INTO tests (id, text) VALUES (5, 'bin')")
+        pg.send(b"P", b"\x00" + sql.encode() + b"\x00" + struct.pack("!H", 0))
+        bind = b"\x00\x00" + struct.pack("!H", 0) + struct.pack("!H", 0)
+        bind += struct.pack("!H", 1) + struct.pack("!H", 1)  # all-binary
+        pg.send(b"B", bind)
+        pg.send(b"D", b"P\x00")
+        pg.send(b"E", b"\x00" + struct.pack("!i", 0))
+        pg.send(b"S")
+        await pg.writer.drain()
+        events, _ = await pg.collect_until_ready()
+        cols, rows, tags, errors = pg._digest(events)
+        assert not errors, errors
+        assert cols == ["id", "text"]
+        assert ["5", "bin"] in rows  # binary-of-text == utf-8 bytes
+
+        # SHOW over extended protocol: Describe yields a RowDescription
+        cols, rows, _, errors, _ = await pg.extended(
+            "SHOW standard_conforming_strings"
+        )
+        assert not errors, errors
+        assert cols == ["standard_conforming_strings"]
+        assert rows == [["on"]]
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())
+
+
+def test_version_over_extended_protocol():
+    """SELECT version() is shimmed (SQLite has no version()): Describe
+    must answer a RowDescription, not NoData followed by a shimmed
+    DataRow (the protocol violation psycopg trips over)."""
+
+    async def main():
+        agent, server, port, _ = await boot()
+        pg = await MiniPg(port).connect()
+        cols, rows, _, errors, _ = await pg.extended("SELECT version()")
+        assert not errors, errors
+        assert cols == ["version"]
+        assert rows and "corrosion-tpu" in rows[0][0]
+        await pg.close()
+        await server.stop()
+        agent.close()
+
+    run(main())

@@ -33,6 +33,11 @@ def small_configs():
             n_nodes=100, n_changes=16, write_rounds=4,
             partition_rounds=10, max_rounds=256,
         ),
+        # the [N, N] per-node view upgrade (model.py swim_per_node_views)
+        "config4_churn_pernode": model.config4_churn100k(seed=7).with_(
+            n_nodes=64, n_changes=16, write_rounds=4,
+            churn_rounds=6, max_rounds=256, swim_per_node_views=True,
+        ),
     }
 
 
@@ -97,6 +102,29 @@ def test_full_state_equality_mid_flight():
     assert complete.sum() / (p.n_nodes * p.n_changes) == pytest.approx(
         ref_partial.coverage[-1]
     )
+
+
+def test_full_state_equality_per_node_views():
+    """The [N, N] per-node view tensor matches the scalar mirror
+    element-wise mid-churn — probe edges, gossip merges, suspicion
+    timers and restart seeding all agree bit-for-bit."""
+    p = small_configs()["config4_churn_pernode"]
+    ref = reference.run_reference(p)
+    probe_round = max(2, ref.rounds // 2)
+    ref_partial = reference.run_reference(p, max_rounds=probe_round)
+
+    step = jax.jit(cluster.make_step(p))
+    state = cluster.init_state(p)
+    assert state[2].shape == (p.n_nodes, p.n_nodes)
+    for _ in range(probe_round):
+        state = step(state)
+    cov = np.asarray(state[0])
+    status = np.asarray(state[2])
+    for n in range(p.n_nodes):
+        assert cov[n].tolist() == ref_partial.cov[n], f"node {n} cov diverged"
+    assert status.tolist() == ref_partial.status, "per-node views diverged"
+    # churn actually exercised failure knowledge: some view is non-ALIVE
+    assert (status != model.ALIVE).any()
 
 
 # -- behavioral properties --------------------------------------------------

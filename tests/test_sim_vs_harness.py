@@ -577,6 +577,24 @@ def _assert_churn_fidelity(n, k, mt, sync_interval, ppm, churn_rounds, down,
         f"churn fidelity broken: harness mean={mh:.3f} ({hr}) vs "
         f"sim mean={ms:.3f} ({sr}) — gap {gap*100:.2f}% > ±2%"
     )
+    # the [N, N] per-node view model (model.py swim_per_node_views — the
+    # upgrade path for regimes where the consensus view's instant-global
+    # detection diverges from real per-node skew) must hold the same bar
+    # on the same seeds; at 48 nodes it matches the harness seed-for-seed
+    spn = []
+    for seed in range(n_trials):
+        pp = churn_params(
+            n, k, mt, sync_interval, ppm, churn_rounds, down, seed
+        ).with_(swim_per_node_views=True)
+        res = run_reference(pp)
+        assert res.converged
+        spn.append(res.rounds)
+    ms_pn = statistics.mean(spn)
+    gap_pn = abs(mh - ms_pn) / ms_pn
+    assert gap_pn <= TOLERANCE, (
+        f"per-node-view fidelity broken: harness mean={mh:.3f} ({hr}) vs "
+        f"per-node sim mean={ms_pn:.3f} ({spn}) — gap {gap_pn*100:.2f}% > ±2%"
+    )
 
 
 def test_round_counts_churn():

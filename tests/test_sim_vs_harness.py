@@ -49,7 +49,7 @@ import time
 
 from corrosion_tpu.agent.agent import make_broadcastable_changes
 from corrosion_tpu.harness import DevCluster, Topology
-from corrosion_tpu.sim.model import SimParams
+from corrosion_tpu.sim.model import ER, SimParams
 from corrosion_tpu.sim.reference import run_reference
 
 SCHEMA = (
@@ -351,7 +351,9 @@ def paired_sync_draw(p: SimParams):
     return draw
 
 
-from corrosion_tpu.sim.rng import TAG_BCAST  # noqa: E402
+from corrosion_tpu.sim.reference import (  # noqa: E402
+    _bcast_target as _ref_bcast_target,
+)
 from corrosion_tpu import wire as _wire  # noqa: E402
 
 
@@ -365,7 +367,7 @@ def install_fanout_pairing(cluster, names, p: SimParams, key_to_k, node, me):
     away the last unpaired randomness in the failure-mode experiments."""
     assert p.nseq_max <= 1, "fanout pairing supports single-chunk payloads"
     S = max(1, p.nseq_max)
-    attempts = p.swim_probe_attempts
+    attempts = p.swim_probe_attempts if p.swim else 1  # ref: reference.py
     addr_of = [("127.0.0.1", cluster._ports[nm]) for nm in names]
 
     def hook(payload):
@@ -384,14 +386,10 @@ def install_fanout_pairing(cluster, names, p: SimParams, key_to_k, node, me):
             slot = j * S  # single-chunk payloads: s = 0
             t_found = first = None
             for a in range(attempts):
-                suffix = () if a == 0 else (a,)
-                u = py_below(
-                    p.n_nodes - 1 - len(chosen), p.seed, TAG_BCAST,
-                    r, me, slot, k, *suffix,
-                )
-                for e in sorted([me] + chosen):
-                    if u >= e:
-                        u += 1
+                # the sim's own draw function IS the pairing source —
+                # any topology it supports pairs for free, and a keying
+                # change can never drift between the two
+                u = _ref_bcast_target(p, r, me, slot, k, a, chosen)
                 if first is None:
                     first = u
                 if addr_of[u] in ups:
@@ -756,5 +754,109 @@ def test_round_counts_partition_heal():
     gap = abs(mh - ms) / ms
     assert gap <= TOLERANCE, (
         f"partition fidelity broken: harness mean={mh:.3f} ({hr}) vs "
+        f"sim mean={ms:.3f} ({sr}) — gap {gap*100:.2f}% > ±2%"
+    )
+
+
+# -- ER topology, push-only (BASELINE config 2's regime) -------------------
+#
+# Config 2 is DEFINED by limited-degree topology + pure push gossip: no
+# anti-entropy repair path exists, so convergence is decided entirely by
+# whether every node's in-neighbors transmit to it within the budget —
+# including honest NON-convergence when they don't.  The harness realizes
+# the static ER out-neighbor table through the paired fanout hook
+# (reference._bcast_target's ER branch) over the real stack; with fully
+# paired draws the miss pattern itself must match: a seed the sim fails
+# to converge must fail identically in the harness.
+
+
+async def one_er_trial(p: SimParams, names):
+    n = p.n_nodes
+    cluster = DevCluster(
+        star_topology(n)[0],
+        schema=SCHEMA,
+        seeded_actors=True,
+        config_tweaks={
+            "perf": {"manual_pacing": True, "flush_interval": 0.01},
+            "gossip": {
+                "max_transmissions": p.max_transmissions,
+                "suspicion_timeout": 30.0,
+            },
+        },
+    )
+    await cluster.start()
+    nodes = [cluster[name] for name in names]
+    try:
+        # 32 real nodes joining via SWIM: generous bound so machine load
+        # cannot flake the only wall-clock phase of this experiment
+        await wait_membership(nodes, timeout=120.0)
+        for node in nodes:
+            node.transport.on_rtt = None
+            for m in node.members.states.values():
+                m.ring = None
+                m.rtts.clear()
+        expected_heads: dict = {}
+        key_to_k: dict = {}
+        for k, origin in enumerate(sim_origins(p)):
+            node = nodes[origin]
+            out = await make_broadcastable_changes(
+                node.agent,
+                [(
+                    "INSERT INTO tests (id,text) VALUES (?,?)",
+                    (next(_ids), "x" * 40),
+                )],
+            )
+            for cs in out.changesets:
+                key_to_k[(bytes(cs.actor_id), cs.changeset.versions)] = k
+            await node.broadcast.enqueue(out.changesets)
+            aid = node.agent.actor_id
+            expected_heads[aid] = expected_heads.get(aid, 0) + 1
+        for i, name in enumerate(names):
+            install_fanout_pairing(
+                cluster, names, p, key_to_k, cluster[name], i
+            )
+        for r in range(p.max_rounds):
+            await cluster.step_round(r, sync_interval=0)
+            if _converged(nodes, expected_heads):
+                return r + 1
+            if all(not nd.broadcast.pending for nd in nodes):
+                # every budget exhausted and no repair path: the outcome
+                # is decided — don't idle through the remaining rounds
+                return None
+        return None  # honest non-convergence (no repair path)
+    finally:
+        await cluster.stop()
+
+
+def test_round_counts_er_push_only():
+    """32 nodes on a static degree-10 ER out-neighbor graph, 12
+    changesets, fanout 3, budget 6, NO anti-entropy (config 2's regime:
+    "suspicion+piggyback disabled", push gossip is the only mechanism).
+    With deaths absent and every fanout draw paired, the harness must
+    reproduce the sim's outcome per seed — round counts AND the
+    convergence verdict itself (a seed whose in-neighbor draws never
+    cover some node must fail identically in both backends)."""
+    n, k = 32, 12
+    _, names = star_topology(n)
+    hr, sr = [], []
+    for seed in range(16):
+        p = SimParams(
+            n_nodes=n, n_changes=k, fanout=3, max_transmissions=6,
+            sync_interval=0, write_rounds=1, max_rounds=MAX_ROUNDS,
+            topology=ER, er_degree=10, fanout_per_change=True, seed=seed,
+        )
+        hr.append(asyncio.run(one_er_trial(p, names)))
+        res = run_reference(p)
+        sr.append(res.rounds if res.converged else None)
+    assert [h is None for h in hr] == [s is None for s in sr], (
+        f"convergence verdicts diverged: harness {hr} vs sim {sr}"
+    )
+    ch = [h for h in hr if h is not None]
+    cs = [s for s in sr if s is not None]
+    assert ch, "no converging seeds — config too weak to discriminate"
+    mh, ms = statistics.mean(ch), statistics.mean(cs)
+    gap = abs(mh - ms) / ms
+    assert gap <= TOLERANCE, (
+        f"ER push-only fidelity broken: harness mean={mh:.3f} ({hr}) vs "
         f"sim mean={ms:.3f} ({sr}) — gap {gap*100:.2f}% > ±2%"
     )

@@ -49,7 +49,7 @@ import time
 
 from corrosion_tpu.agent.agent import make_broadcastable_changes
 from corrosion_tpu.harness import DevCluster, Topology
-from corrosion_tpu.sim.model import ER, SimParams
+from corrosion_tpu.sim.model import ER, POWERLAW, SimParams
 from corrosion_tpu.sim.reference import run_reference
 
 SCHEMA = (
@@ -770,7 +770,11 @@ def test_round_counts_partition_heal():
 # to converge must fail identically in the harness.
 
 
-async def one_er_trial(p: SimParams, names):
+async def one_topology_trial(p: SimParams, names):
+    """Static-membership trial over a drawn topology (ER / powerlaw):
+    paired origins, paired fanout (the sim's own _bcast_target), paired
+    sync draws when p.sync_interval > 0; returns rounds or None on
+    honest non-convergence."""
     n = p.n_nodes
     cluster = DevCluster(
         star_topology(n)[0],
@@ -790,8 +794,12 @@ async def one_er_trial(p: SimParams, names):
         # 32 real nodes joining via SWIM: generous bound so machine load
         # cannot flake the only wall-clock phase of this experiment
         await wait_membership(nodes, timeout=120.0)
-        for node in nodes:
+        for i, node in enumerate(nodes):
             node.transport.on_rtt = None
+            # belt + braces: a payload missing the draw hook's key map
+            # would fall back to broadcast.rng — keep that path seeded
+            # so it can never produce an unreproducible trial
+            node.broadcast.rng = random.Random((p.seed + 1) * 1000 + i)
             for m in node.members.states.values():
                 m.ring = None
                 m.rtts.clear()
@@ -815,11 +823,20 @@ async def one_er_trial(p: SimParams, names):
             install_fanout_pairing(
                 cluster, names, p, key_to_k, cluster[name], i
             )
+        attempts = p.swim_probe_attempts if p.swim else 1
         for r in range(p.max_rounds):
-            await cluster.step_round(r, sync_interval=0)
+            await cluster.step_round(
+                r,
+                sync_interval=p.sync_interval,
+                sync_draw=paired_sync_draw(p),
+                sync_attempts=attempts,
+            )
             if _converged(nodes, expected_heads):
                 return r + 1
-            if all(not nd.broadcast.pending for nd in nodes):
+            if p.sync_interval == 0 and all(
+                not nd.broadcast.pending and nd.broadcast._queue.empty()
+                for nd in nodes
+            ):
                 # every budget exhausted and no repair path: the outcome
                 # is decided — don't idle through the remaining rounds
                 return None
@@ -845,7 +862,7 @@ def test_round_counts_er_push_only():
             sync_interval=0, write_rounds=1, max_rounds=MAX_ROUNDS,
             topology=ER, er_degree=10, fanout_per_change=True, seed=seed,
         )
-        hr.append(asyncio.run(one_er_trial(p, names)))
+        hr.append(asyncio.run(one_topology_trial(p, names)))
         res = run_reference(p)
         sr.append(res.rounds if res.converged else None)
     assert [h is None for h in hr] == [s is None for s in sr], (
@@ -858,5 +875,34 @@ def test_round_counts_er_push_only():
     gap = abs(mh - ms) / ms
     assert gap <= TOLERANCE, (
         f"ER push-only fidelity broken: harness mean={mh:.3f} ({hr}) vs "
+        f"sim mean={ms:.3f} ({sr}) — gap {gap*100:.2f}% > ±2%"
+    )
+
+
+def test_round_counts_powerlaw_sync_assisted():
+    """32 nodes on the hub-biased powerlaw topology (config 3's draw:
+    min of gamma=3 uniform draws skews fanout toward low-index hubs),
+    12 changesets, budget 3, sync every 4: hub bias concentrates early
+    dissemination, and the round-4 anti-entropy sweep picks up the
+    periphery — a paired knife-edge between 8 and 12 rounds."""
+    n, k = 32, 12
+    _, names = star_topology(n)
+    hr, sr = [], []
+    for seed in range(16):
+        p = SimParams(
+            n_nodes=n, n_changes=k, fanout=3, max_transmissions=3,
+            sync_interval=4, write_rounds=1, max_rounds=MAX_ROUNDS,
+            topology=POWERLAW, powerlaw_gamma=3,
+            fanout_per_change=True, seed=seed,
+        )
+        hr.append(asyncio.run(one_topology_trial(p, names)))
+        res = run_reference(p)
+        assert res.converged
+        sr.append(res.rounds)
+    assert all(h is not None for h in hr), hr
+    mh, ms = statistics.mean(hr), statistics.mean(sr)
+    gap = abs(mh - ms) / ms
+    assert gap <= TOLERANCE, (
+        f"powerlaw fidelity broken: harness mean={mh:.3f} ({hr}) vs "
         f"sim mean={ms:.3f} ({sr}) — gap {gap*100:.2f}% > ±2%"
     )

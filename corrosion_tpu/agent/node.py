@@ -210,6 +210,7 @@ class Node:
             probe_timeout=self.config.gossip.probe_timeout,
             suspicion_timeout=self.config.gossip.suspicion_timeout,
             announce_down_period=self.config.gossip.announce_down_period,
+            feed_every_acks=self.config.gossip.feed_every_acks,
         )
         impl = self.config.gossip.swim_impl
         if impl not in ("native", "python"):

@@ -56,6 +56,14 @@ class SwimConfig:
     # reference uses; without it a two-sided partition NEVER re-merges —
     # probes only target non-DOWN members).  0 disables.
     announce_down_period: float = 30.0
+    # periodic gossip (ref: foca's periodic_gossip, also in the WAN
+    # tuning): every Nth ack additionally carries a feed of random ALIVE
+    # members.  Join updates ride a BOUNDED piggyback epidemic
+    # (update_retransmits sends), which can die out before reaching every
+    # node in a larger cluster bootstrapping off one hub — two mutually
+    # ignorant members then stay disconnected forever; the recurring feed
+    # heals such partial views organically.  0 disables.
+    feed_every_acks: int = 10
 
 
 @dataclass
@@ -97,6 +105,7 @@ class Swim:
             now + self.config.announce_down_period if self.config.announce_down_period > 0 else None
         )
         self._probe_seq = 0
+        self._acks_sent = 0
         # seq -> (target ActorId, direct_deadline, indirect_deadline, acked)
         self._probes: Dict[int, list] = {}
         # probe order shuffling (round-robin through shuffled membership)
@@ -119,6 +128,25 @@ class Swim:
                 state=state,
                 incarnation=incarnation,
                 sends_left=self.config.update_retransmits,
+            ),
+        )
+
+    def _send_feed(self, sender: Actor, piggyback: bool) -> None:
+        """Send ``sender`` a feed of up to 10 random ALIVE members (the
+        announce response and the periodic feed-on-ack share this)."""
+        feed = [
+            actor_to_obj(m.actor)
+            for m in self.members.values()
+            if m.state == ALIVE and m.actor.id != sender.id
+        ]
+        self.rng.shuffle(feed)
+        self._emit(
+            sender.addr,
+            (
+                "feed",
+                actor_to_obj(self.identity),
+                feed[:10],
+                self._piggyback() if piggyback else [],
             ),
         )
 
@@ -418,6 +446,17 @@ class Swim:
                 sender.addr,
                 ("ack", seq, actor_to_obj(self.identity), self._piggyback()),
             )
+            self._acks_sent += 1
+            if (
+                self.config.feed_every_acks > 0
+                and self._acks_sent % self.config.feed_every_acks == 0
+            ):
+                # periodic gossip: a feed of random alive members rides
+                # along so partial membership views heal (see SwimConfig).
+                # No piggyback: the ack just spent one retransmit of each
+                # queued update on this same peer — a second copy here
+                # would shrink the epidemic's reach by one distinct peer
+                self._send_feed(sender, piggyback=False)
         elif kind == "fwd_ping":
             _, seq, origin_obj, from_obj, pb = msg
             origin = actor_from_obj(origin_obj)
@@ -462,21 +501,7 @@ class Swim:
             (_, from_obj) = msg
             sender = actor_from_obj(from_obj)
             self._observe_alive(sender, 0, now, direct=True)
-            feed = [
-                actor_to_obj(m.actor)
-                for m in self.members.values()
-                if m.state == ALIVE and m.actor.id != sender.id
-            ]
-            self.rng.shuffle(feed)
-            self._emit(
-                sender.addr,
-                (
-                    "feed",
-                    actor_to_obj(self.identity),
-                    feed[:10],
-                    self._piggyback(),
-                ),
-            )
+            self._send_feed(sender, piggyback=True)
         elif kind == "feed":
             _, from_obj, actors, pb = msg
             self._observe_alive(actor_from_obj(from_obj), 0, now, direct=True)

@@ -61,6 +61,7 @@ def load() -> ctypes.CDLL:
             ctypes.c_int,  # update_retransmits
             ctypes.c_double,  # remove_down_after
             ctypes.c_double,  # announce_down_period
+            ctypes.c_int,  # feed_every_acks
             ctypes.c_uint64,  # seed
             ctypes.c_double,  # now
         ]
@@ -139,6 +140,7 @@ class NativeSwim:
             cfg.update_retransmits,
             cfg.remove_down_after,
             cfg.announce_down_period,
+            cfg.feed_every_acks,
             seed,
             now,
         )

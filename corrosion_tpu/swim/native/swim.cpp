@@ -275,6 +275,10 @@ struct Config {
   // never target DOWN entries, so a healed partition would otherwise stay
   // split forever); 0 disables.  Mirrors swim/core.py.
   double announce_down_period = 30.0;
+  // periodic gossip: every Nth ack also carries a feed of random ALIVE
+  // members, healing partial membership views the bounded piggyback
+  // epidemic can leave behind; 0 disables.  Mirrors swim/core.py.
+  int feed_every_acks = 10;
 };
 
 struct MemberEntry {
@@ -464,6 +468,14 @@ class Core {
       msg.push_back(identity_.to_obj());
       msg.push_back(piggyback());
       emit(sender.host, sender.port, std::move(msg));
+      acks_sent_ += 1;
+      if (cfg_.feed_every_acks > 0 &&
+          acks_sent_ % cfg_.feed_every_acks == 0) {
+        // periodic gossip: a feed of random alive members rides along so
+        // partial membership views heal (see Config).  No piggyback: the
+        // ack just spent one retransmit per queued update on this peer
+        send_feed(sender, /*with_piggyback=*/false);
+      }
     } else if (kind == "fwd_ping" && m.size() >= 6) {
       uint64_t seq = m[2].as_u64();
       Actor origin, from;
@@ -511,19 +523,7 @@ class Core {
       Actor sender;
       if (!Actor::from_obj(m[2], sender)) return;
       observe_alive(sender, 0, now, /*direct=*/true);
-      std::vector<MemberEntry*> feed;
-      for (auto& [id, mem] : members_)
-        if (mem.state == ALIVE && id != sender.id) feed.push_back(&mem);
-      std::shuffle(feed.begin(), feed.end(), rng_);
-      mp::ValueVec actors;
-      int count = std::min<int>(10, feed.size());
-      for (int i = 0; i < count; ++i) actors.push_back(feed[i]->actor.to_obj());
-      mp::ValueVec msg;
-      msg.push_back(mp::Value::str("feed"));
-      msg.push_back(identity_.to_obj());
-      msg.push_back(mp::Value::array(std::move(actors)));
-      msg.push_back(piggyback());
-      emit(sender.host, sender.port, std::move(msg));
+      send_feed(sender, /*with_piggyback=*/true);
     } else if (kind == "feed" && m.size() >= 5) {
       Actor sender;
       if (!Actor::from_obj(m[2], sender)) return;
@@ -618,6 +618,7 @@ class Core {
   std::map<uint64_t, Probe> probes_;
   std::vector<std::string> probe_queue_;
   uint64_t probe_seq_ = 0;
+  uint64_t acks_sent_ = 0;
   double next_probe_at_ = 0.0;
   double next_announce_down_at_ = -1.0;
 
@@ -628,6 +629,25 @@ class Core {
     std::string buf;
     mp::encode(mp::Value::array(std::move(tagged)), buf);
     out_.push_back(Output{host, port, std::move(buf)});
+  }
+
+  // a feed of up to 10 random ALIVE members (the announce response and
+  // the periodic feed-on-ack share this; mirrors swim/core.py _send_feed)
+  void send_feed(const Actor& sender, bool with_piggyback) {
+    std::vector<MemberEntry*> feed;
+    for (auto& [id, mem] : members_)
+      if (mem.state == ALIVE && id != sender.id) feed.push_back(&mem);
+    std::shuffle(feed.begin(), feed.end(), rng_);
+    mp::ValueVec actors;
+    int count = std::min<int>(10, feed.size());
+    for (int i = 0; i < count; ++i) actors.push_back(feed[i]->actor.to_obj());
+    mp::ValueVec msg;
+    msg.push_back(mp::Value::str("feed"));
+    msg.push_back(identity_.to_obj());
+    msg.push_back(mp::Value::array(std::move(actors)));
+    msg.push_back(with_piggyback ? piggyback()
+                                 : mp::Value::array(mp::ValueVec{}));
+    emit(sender.host, sender.port, std::move(msg));
   }
 
   void queue_update(const Actor& actor, const std::string& state,
@@ -808,7 +828,8 @@ void* swim_new(const uint8_t* id16, const char* host, int64_t port,
                double probe_timeout, int num_indirect_probes,
                double suspicion_timeout, int max_piggyback,
                int update_retransmits, double remove_down_after,
-               double announce_down_period, uint64_t seed, double now) {
+               double announce_down_period, int feed_every_acks,
+               uint64_t seed, double now) {
   swim::Actor identity;
   identity.id.assign(reinterpret_cast<const char*>(id16), 16);
   identity.host = host;
@@ -824,6 +845,7 @@ void* swim_new(const uint8_t* id16, const char* host, int64_t port,
   cfg.update_retransmits = update_retransmits;
   cfg.remove_down_after = remove_down_after;
   cfg.announce_down_period = announce_down_period;
+  cfg.feed_every_acks = feed_every_acks;
   return new swim::Core(std::move(identity), cfg, seed, now);
 }
 

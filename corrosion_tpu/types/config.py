@@ -78,6 +78,9 @@ class GossipConfig:
     # partition-heal: period of announces to one random DOWN member (see
     # swim/core.py SwimConfig.announce_down_period); 0 disables
     announce_down_period: float = 30.0
+    # periodic gossip: every Nth ack carries a feed of random alive
+    # members (see SwimConfig.feed_every_acks); 0 disables
+    feed_every_acks: int = 10
     # SWIM core implementation: "native" (C++ sans-IO core, the default —
     # the foca-equivalent is a native component in the reference) or
     # "python" (the executable spec in swim/core.py); both speak the same

@@ -458,6 +458,9 @@ async def one_churn_trial(p: SimParams, names):
                 # suspect at ~+0.7 in its round; DOWN on the round
                 # boundary SUSPICION_ROUNDS later (harness/swim_phase)
                 "suspicion_timeout": SUSPICION_ROUNDS - 0.7,
+                # periodic-gossip feeds would consume the seeded swim
+                # rng and re-roll the validated draw streams
+                "feed_every_acks": 0,
             },
         },
     )
@@ -673,6 +676,7 @@ async def one_partition_trial(p: SimParams, names):
                 # one announce-to-down per round: the real heal mechanism
                 # the sim abstracts as swim_rejoin_rounds
                 "announce_down_period": 1.0,
+                "feed_every_acks": 0,
             },
         },
     )
@@ -785,15 +789,17 @@ async def one_topology_trial(p: SimParams, names):
             "gossip": {
                 "max_transmissions": p.max_transmissions,
                 "suspicion_timeout": 30.0,
+                "swim_impl": "python",  # seedable membership
             },
         },
     )
     await cluster.start()
     nodes = [cluster[name] for name in names]
     try:
-        # 32 real nodes joining via SWIM: generous bound so machine load
-        # cannot flake the only wall-clock phase of this experiment
-        await wait_membership(nodes, timeout=120.0)
+        # static complete membership is the experiment premise (the
+        # topology exists only through the paired fanout draws) — seed it
+        # rather than depend on wall-clock join gossip
+        cluster.seed_full_membership()
         for i, node in enumerate(nodes):
             node.transport.on_rtt = None
             # belt + braces: a payload missing the draw hook's key map

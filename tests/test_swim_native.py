@@ -273,3 +273,39 @@ def test_two_sided_partition_heals_automatically(impls):
         for j, other in enumerate(nodes):
             if i != j:
                 assert swim.members[other.identity.id].state == ALIVE, (i, j)
+
+
+def test_periodic_feed_heals_partial_membership(impls):
+    """Join updates ride a BOUNDED piggyback epidemic that can die out
+    before reaching everyone (observed: two mutually-ignorant members in
+    a 32-node star bootstrap staying disconnected forever).  The
+    periodic feed-on-ack (foca's periodic_gossip) must heal such partial
+    views: b and c only know a; a's recurring feeds introduce them."""
+    cfg = SwimConfig(
+        probe_period=0.3,
+        probe_timeout=0.1,
+        # kill the join epidemic so ONLY the periodic feed can heal
+        update_retransmits=1,
+        feed_every_acks=2,
+    )
+    net = DatagramNet(impls, cfg, seed=3)
+    a, b, c = net.add(1), net.add(2), net.add(3)
+    # partial views installed directly: no announce exchange (which would
+    # feed immediately) — b and c each know only a, a knows both
+    for src, tgt in ((a, b), (a, c), (b, a), (c, a)):
+        net.inject(src, ("announce", list(actor_to_obj(tgt.identity))), 0.0)
+    # drain a's queued join updates so the piggyback epidemic cannot heal
+    # the views (pings from known members make a spend its retransmits),
+    # then discard every queued response — only the periodic feed remains
+    net.inject(a, ("ping", 71, list(actor_to_obj(b.identity)), []), 0.0)
+    net.inject(a, ("ping", 72, list(actor_to_obj(c.identity)), []), 0.0)
+    for swim in (a, b, c):
+        swim.take_datagrams()
+    assert len(b.up_members()) == 1 and len(c.up_members()) == 1
+    net.run(until=6.0)
+    assert {m.id for m in b.up_members()} == {
+        a.identity.id, c.identity.id
+    }, "b never learned c"
+    assert {m.id for m in c.up_members()} == {
+        a.identity.id, b.identity.id
+    }, "c never learned b"

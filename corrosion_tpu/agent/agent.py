@@ -8,7 +8,6 @@ operations the runtime loops drive.
 
 from __future__ import annotations
 
-import asyncio
 import sqlite3
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -21,7 +20,6 @@ from ..types.sync_state import SyncStateV1
 from . import apply as apply_mod
 from .bookkeeping import (
     Booked,
-    BookedVersions,
     Bookie,
     Cleared,
     Current,

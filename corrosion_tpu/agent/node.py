@@ -24,7 +24,7 @@ from ..swim.core import Swim, SwimConfig
 from ..sync.session import SyncServer, parallel_sync
 from ..transport.net import Transport
 from ..types.actor import Actor, ActorId
-from ..types.broadcast import ChangeSource, ChangeV1
+from ..types.broadcast import ChangeSource
 from ..types.config import Config, parse_addr
 from ..types.members import Members
 from ..types.schema import apply_schema

@@ -1,0 +1,101 @@
+"""graftlint — repo-native static analysis for corrosion-tpu.
+
+Three cooperating passes (see doc/lint.md for the rule catalogue):
+
+1. JAX trace-safety (GL1xx) over ``sim/`` and ``crdt/``
+2. async lock discipline (GL2xx) over the agent runtime
+3. abstract shape/dtype contracts (GL3xx) via ``jax.eval_shape``
+
+Entry point: ``python -m corrosion_tpu.cli lint [--json] [--fail-on=...]``
+or :func:`lint_repo` / :func:`lint_paths` from code.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from . import async_discipline, contracts, trace_safety
+from .report import exit_code, render_json, render_text, severity_counts
+from .rules import RULES, Finding, sort_findings
+from .suppress import apply_suppressions, scan_suppressions
+
+# Pass scopes, relative to the package root (corrosion_tpu/).
+TRACE_SAFETY_DIRS = ("sim", "crdt")
+ASYNC_DIRS = ("agent", "swim", "sync", "broadcast", "transport")
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _py_files(root: str, subdirs: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for sub in subdirs:
+        base = os.path.join(root, "corrosion_tpu", sub)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def lint_file(path: str, repo_root: Optional[str] = None) -> List[Finding]:
+    """Run the applicable AST passes over one file, with suppressions."""
+    root = repo_root or os.path.dirname(_PKG_ROOT)
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, root)
+    findings: List[Finding] = []
+    parts = rel.replace(os.sep, "/").split("/")
+    scope = parts[1] if len(parts) > 1 and parts[0] == "corrosion_tpu" else None
+    if scope in TRACE_SAFETY_DIRS or scope is None:
+        findings.extend(trace_safety.check_source(rel, source))
+    if scope in ASYNC_DIRS or scope is None:
+        findings.extend(async_discipline.check_source(rel, source))
+    sups, meta = scan_suppressions(rel, source)
+    findings = apply_suppressions(findings, sups)
+    findings.extend(meta)
+    return findings
+
+
+def lint_paths(paths: Sequence[str], repo_root: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _d, filenames in os.walk(p):
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        findings.extend(
+                            lint_file(os.path.join(dirpath, fn), repo_root)
+                        )
+        else:
+            findings.extend(lint_file(p, repo_root))
+    return sort_findings(findings)
+
+
+def lint_repo(
+    repo_root: Optional[str] = None, with_contracts: bool = True
+) -> List[Finding]:
+    """The full pass: AST lints over their scoped dirs + the eval_shape
+    contract checks.  This is what ``cli lint`` and the agent's
+    ``--self-check`` run."""
+    root = repo_root or os.path.dirname(_PKG_ROOT)
+    findings: List[Finding] = []
+    for path in _py_files(root, TRACE_SAFETY_DIRS + ASYNC_DIRS):
+        findings.extend(lint_file(path, root))
+    if with_contracts:
+        findings.extend(contracts.check_transition())
+    return sort_findings(findings)
+
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "lint_file",
+    "lint_paths",
+    "lint_repo",
+    "render_text",
+    "render_json",
+    "severity_counts",
+    "exit_code",
+    "sort_findings",
+]

@@ -1,0 +1,343 @@
+"""Async lock-discipline pass (GL201–GL204) over the agent runtime.
+
+Scope: ``agent/``, ``swim/``, ``sync/``, ``broadcast/``, ``transport/``.
+
+The repo's locking idiom is ``async with <lock>:`` where the context
+expression is an ``asyncio.Lock`` / ``Semaphore`` / ``Condition``
+attribute, or the CountedRwLock pattern ``async with booked.read(label)``
+/ ``.write(label)`` from agent/bookkeeping.py.  We treat any
+``async with`` whose context expression mentions a lock-ish name
+(``lock``, ``sem``, ``semaphore``, ``cond``, or a ``.read(...)`` /
+``.write(...)`` call on one) as a held-lock region.
+
+GL201 fires when, inside such a region, an ``await`` targets a
+network/sleep call — sends are included (a stalled peer blocks the
+holder just as surely as a recv).  GL203 fires on receive-side peer
+I/O awaited with no timeout anywhere in the call (no ``timeout=`` /
+``deadline=`` kwarg and not wrapped in ``asyncio.wait_for``).  GL204
+fires on ``asyncio.create_task(...)`` used as a bare expression
+statement — assigning the handle, appending it to a collection, or
+passing it on all count as keeping it.  GL202 fires on attributes that
+are *read or written under a lock* somewhere in the class but also
+*written bare* from an async method — the mixed pattern where the next
+await point introduces a lost update.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .rules import Finding, GL201, GL202, GL203, GL204
+
+_LOCKISH_NAME_PARTS = ("lock", "sem", "cond", "mutex")
+_RWLOCK_METHODS = {"read", "write"}
+
+# Awaited calls that are "network or sleep" for GL201.
+_BLOCKING_CALL_NAMES = {
+    "sleep",
+    "send",
+    "send_uni",
+    "send_bi",
+    "sendto",
+    "recv",
+    "recv_exact",
+    "read",
+    "readexactly",
+    "readline",
+    "drain",
+    "connect",
+    "open_connection",
+    "start_server",
+    "wait_for",
+    "gather",
+    "request",
+    "get",
+    "post",
+    "fetch",
+}
+
+# Receive-side peer I/O that must be bounded for GL203.
+_PEER_IO_NAMES = {
+    "recv",
+    "recv_exact",
+    "read",
+    "readexactly",
+    "readline",
+    "open_connection",
+    "connect",
+}
+
+_TIMEOUT_KWARGS = {"timeout", "deadline", "timeout_s", "timeout_ms"}
+
+
+def _func_name(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _expr_names(node: ast.expr) -> List[str]:
+    """All identifier-ish parts of an expression, lowercased."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.append(sub.id.lower())
+        elif isinstance(sub, ast.Attribute):
+            out.append(sub.attr.lower())
+    return out
+
+
+def _is_lock_ctx(item: ast.expr) -> bool:
+    """Does this ``async with`` context expression look like a lock?
+
+    Matches bare lock attributes (``self._lock``, ``send_lock``, the
+    write semaphore) and the CountedRwLock ``booked.read(label)`` /
+    ``.write(label)`` calls.  Timeout guards (``asyncio.timeout(...)``)
+    and stream/session contexts do not match.
+    """
+    if isinstance(item, ast.Call):
+        fname = _func_name(item.func)
+        if fname in _RWLOCK_METHODS and isinstance(item.func, ast.Attribute):
+            return True
+        # lock.acquire_timeout()-style helpers
+        if fname and any(p in fname.lower() for p in _LOCKISH_NAME_PARTS):
+            return True
+        return False
+    names = _expr_names(item)
+    return any(any(p in n for p in _LOCKISH_NAME_PARTS) for n in names)
+
+
+def _call_has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg in _TIMEOUT_KWARGS for kw in call.keywords)
+
+
+class _AsyncFuncChecker(ast.NodeVisitor):
+    """Check one async function body; tracks the held-lock stack."""
+
+    def __init__(self, path: str, checker: "_ModuleChecker"):
+        self.path = path
+        self.checker = checker
+        self.lock_stack: List[str] = []
+        self.findings: List[Finding] = []
+
+    def _emit(self, rule, node, message):
+        self.findings.append(
+            Finding(
+                path=self.path,
+                line=node.lineno,
+                rule=rule.id,
+                severity=rule.severity,
+                message=message,
+            )
+        )
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs get their own pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        lock_items = [
+            ast.unparse(item.context_expr)
+            for item in node.items
+            if _is_lock_ctx(item.context_expr)
+        ]
+        self.lock_stack.extend(lock_items)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in lock_items:
+            self.lock_stack.pop()
+
+    def visit_Await(self, node: ast.Await):
+        call = node.value if isinstance(node.value, ast.Call) else None
+        fname = _func_name(call.func) if call else None
+
+        # GL201: blocking network/sleep await while a lock is held.
+        if self.lock_stack and fname in _BLOCKING_CALL_NAMES:
+            self._emit(
+                GL201,
+                node,
+                f"await {fname}() while holding {self.lock_stack[-1]!r} — "
+                "snapshot under the lock and perform I/O outside it",
+            )
+
+        # GL203: unbounded receive-side peer I/O.
+        if call is not None and fname in _PEER_IO_NAMES:
+            # asyncio.wait_for(inner(...), timeout) bounds the inner call.
+            inner_bounded = fname == "wait_for"
+            if not inner_bounded and not _call_has_timeout(call):
+                # Walk up: only flag if not already the argument of a
+                # wait_for — approximated by checking the awaited call
+                # itself, since wait_for wraps the coroutine object.
+                self._emit(
+                    GL203,
+                    node,
+                    f"await {fname}() with no timeout — a stalled peer "
+                    "parks this coroutine forever; use asyncio.wait_for "
+                    "or a timeout/deadline kwarg",
+                )
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr):
+        # GL204: bare `asyncio.create_task(...)` as a statement.
+        v = node.value
+        if (
+            isinstance(v, ast.Call)
+            and _func_name(v.func) == "create_task"
+        ):
+            self._emit(
+                GL204,
+                node,
+                "create_task() result dropped — keep the handle (track it "
+                "in a task set and add a done-callback) so exceptions "
+                "surface and shutdown can cancel it",
+            )
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        # Record bare attribute writes for GL202 (outside any lock only).
+        if not self.lock_stack:
+            for tgt in node.targets:
+                attr = self.checker.self_attr(tgt)
+                if attr:
+                    self.checker.bare_writes.setdefault(attr, []).append(
+                        (self.path, node.lineno)
+                    )
+        else:
+            for tgt in node.targets:
+                attr = self.checker.self_attr(tgt)
+                if attr:
+                    self.checker.locked_attrs.add(attr)
+        self.generic_visit(node)
+
+
+class _ModuleChecker:
+    """GL202 needs cross-method state: which self-attributes are touched
+    under a lock anywhere vs written bare in async methods."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.locked_attrs: Set[str] = set()
+        self.bare_writes: Dict[str, List[Tuple[str, int]]] = {}
+
+    @staticmethod
+    def self_attr(tgt: ast.expr) -> Optional[str]:
+        if (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"
+        ):
+            return tgt.attr
+        return None
+
+    def lock_guarded_attrs(self, fn: ast.AsyncFunctionDef) -> Set[str]:
+        """Self-attributes read or written inside a held-lock region."""
+        out: Set[str] = set()
+
+        def walk(node, held: bool):
+            if isinstance(node, ast.AsyncWith):
+                now_held = held or any(
+                    _is_lock_ctx(i.context_expr) for i in node.items
+                )
+                for child in node.body:
+                    walk(child, now_held)
+                return
+            if held:
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == "self"
+                    ):
+                        out.add(sub.attr)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in fn.body:
+            walk(stmt, False)
+        return out
+
+
+def check_source(path: str, source: str) -> List[Finding]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                path=path,
+                line=e.lineno or 1,
+                rule=GL201.id,
+                severity="error",
+                message=f"file does not parse: {e.msg}",
+            )
+        ]
+
+    findings: List[Finding] = []
+
+    # Per-class GL202 state; per-function GL201/203/204.
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)] + [tree]:
+        mod = _ModuleChecker(path)
+        async_fns = [
+            n
+            for n in ast.walk(cls)
+            if isinstance(n, ast.AsyncFunctionDef)
+        ] if isinstance(cls, ast.ClassDef) else []
+
+        guarded: Set[str] = set()
+        for fn in async_fns:
+            guarded |= mod.lock_guarded_attrs(fn)
+
+        if isinstance(cls, ast.Module):
+            # Module-level: run the per-function checks on functions not
+            # inside any class (avoid double-reporting class methods).
+            class_fns = {
+                f
+                for c in ast.walk(tree)
+                if isinstance(c, ast.ClassDef)
+                for f in ast.walk(c)
+                if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            for fn in ast.walk(tree):
+                if (
+                    isinstance(fn, ast.AsyncFunctionDef)
+                    and fn not in class_fns
+                ):
+                    chk = _AsyncFuncChecker(path, mod)
+                    for stmt in fn.body:
+                        chk.visit(stmt)
+                    findings.extend(chk.findings)
+            continue
+
+        for fn in async_fns:
+            chk = _AsyncFuncChecker(path, mod)
+            for stmt in fn.body:
+                chk.visit(stmt)
+            findings.extend(chk.findings)
+
+        # GL202: attribute guarded somewhere, but also written bare in an
+        # async method of the same class.  Plain-container mutation
+        # (append/pop on a dict/list) is out of scope — only rebinding
+        # writes count, which is where the lost-update pattern bites.
+        for attr in sorted(guarded & set(mod.bare_writes)):
+            if attr.startswith("__"):
+                continue
+            for p, line in mod.bare_writes[attr]:
+                findings.append(
+                    Finding(
+                        path=p,
+                        line=line,
+                        rule=GL202.id,
+                        severity=GL202.severity,
+                        message=(
+                            f"self.{attr} is accessed under a lock elsewhere "
+                            "in this class but rebound here without it — "
+                            "take the lock or document why the race is benign"
+                        ),
+                    )
+                )
+    return findings

@@ -1,0 +1,149 @@
+"""Abstract contract checker (GL301–GL303).
+
+Traces the one-round sim transition from ``sim/cluster.py`` with
+``jax.eval_shape`` — fully abstract, no FLOPs, no device buffers — and
+asserts three contracts on the state pytree at each probe size:
+
+- **GL301** round-over-round stability: ``eval_shape(step, state)``
+  must return a pytree with exactly the shapes/dtypes of its input
+  (the ``lax.while_loop`` carry contract).
+- **GL302** no wide dtypes: no float64/int64 leaf anywhere in the
+  state (TPU fidelity + HBM budget).
+- **GL303** clean trace: tracing runs under
+  ``jax.check_tracer_leaks()`` and must not raise.
+
+Because ``eval_shape`` never executes the step, checking N=100_000
+costs only trace time (the acceptance bar is <10 s on CPU; in practice
+it is well under that — ``make_step``'s eager ``_consts`` builds a few
+int32[N] host arrays, ~400 KB at 100k).
+
+JAX import is deferred to call time so ``graftlint``'s AST passes work
+even in environments without jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+from .rules import Finding, GL301, GL302, GL303
+
+# Probe sizes from the issue: small / paper-scale / north-star scale.
+PROBE_SIZES = (128, 10_000, 100_000)
+
+_WIDE = {"float64", "int64", "uint64", "complex128"}
+
+_PATH = "corrosion_tpu/sim/cluster.py"
+
+
+def _probe_params(n: int):
+    """A SimParams sized to *n* nodes, derived from the nearest BASELINE
+    config so topology/protocol knobs stay representative."""
+    from ..sim import model
+
+    if n <= 1000:
+        base = model.config1_ring3()
+    elif n <= 50_000:
+        base = model.config3_powerlaw10k()
+    else:
+        base = model.config4_churn100k()
+    return dataclasses.replace(base, n_nodes=n)
+
+
+def _leaf_items(tree):
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    return leaves
+
+
+def check_transition(sizes=PROBE_SIZES) -> List[Finding]:
+    """Run the abstract contract checks; return findings (empty = clean)."""
+    import jax
+
+    from ..sim import cluster
+
+    findings: List[Finding] = []
+    for n in sizes:
+        p = _probe_params(n)
+        try:
+            with jax.check_tracer_leaks():
+                state_shape = jax.eval_shape(lambda: cluster.init_state(p))
+                step = cluster.make_step(p)
+                out_shape = jax.eval_shape(step, state_shape)
+        except Exception as e:  # noqa: BLE001 — any trace failure is the finding
+            findings.append(
+                Finding(
+                    path=_PATH,
+                    line=1,
+                    rule=GL303.id,
+                    severity=GL303.severity,
+                    message=(
+                        f"N={n}: tracing the one-round transition failed "
+                        f"under check_tracer_leaks: {type(e).__name__}: {e}"
+                    ),
+                )
+            )
+            continue
+
+        in_leaves = _leaf_items(state_shape)
+        out_leaves = _leaf_items(out_shape)
+        findings.extend(stability_findings(n, in_leaves, out_leaves))
+        findings.extend(wide_dtype_findings(n, in_leaves))
+    return findings
+
+
+def stability_findings(n: int, in_leaves, out_leaves) -> List[Finding]:
+    """GL301: the transition's output pytree must match its input
+    leaf-for-leaf in shape and dtype (the while_loop carry contract)."""
+    if len(in_leaves) != len(out_leaves):
+        return [
+            Finding(
+                path=_PATH,
+                line=1,
+                rule=GL301.id,
+                severity=GL301.severity,
+                message=(
+                    f"N={n}: state pytree changed arity over one round "
+                    f"({len(in_leaves)} -> {len(out_leaves)} leaves)"
+                ),
+            )
+        ]
+    out: List[Finding] = []
+    for i, (a, b) in enumerate(zip(in_leaves, out_leaves)):
+        if a.shape != b.shape or a.dtype != b.dtype:
+            out.append(
+                Finding(
+                    path=_PATH,
+                    line=1,
+                    rule=GL301.id,
+                    severity=GL301.severity,
+                    message=(
+                        f"N={n}: state leaf {i} drifts over one round: "
+                        f"{a.shape}/{a.dtype} -> {b.shape}/{b.dtype} — "
+                        "the while_loop carry must be shape/dtype-stable"
+                    ),
+                )
+            )
+    return out
+
+
+def wide_dtype_findings(n: int, leaves) -> List[Finding]:
+    """GL302: no float64/int64 anywhere in the state pytree."""
+    out: List[Finding] = []
+    for i, leaf in enumerate(leaves):
+        if str(leaf.dtype) in _WIDE:
+            out.append(
+                Finding(
+                    path=_PATH,
+                    line=1,
+                    rule=GL302.id,
+                    severity=GL302.severity,
+                    message=(
+                        f"N={n}: state leaf {i} is {leaf.dtype} — the sim "
+                        "state must stay 32-bit or narrower "
+                        "(TPU fidelity contract, HBM at 100k nodes)"
+                    ),
+                )
+            )
+    return out

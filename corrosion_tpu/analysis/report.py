@@ -1,0 +1,51 @@
+"""Finding aggregation and rendering (text + JSON)."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from .rules import ERROR, RULES, Finding, sort_findings
+
+
+def severity_counts(findings: List[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {"error": 0, "warning": 0}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    return counts
+
+
+def render_text(findings: List[Finding]) -> str:
+    lines = []
+    for f in sort_findings(findings):
+        lines.append(f"{f.path}:{f.line}: {f.rule} [{f.severity}] {f.message}")
+    counts = severity_counts(findings)
+    lines.append(
+        f"graftlint: {counts['error']} error(s), {counts['warning']} warning(s)"
+        if findings
+        else "graftlint: clean"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding]) -> str:
+    counts = severity_counts(findings)
+    return json.dumps(
+        {
+            "findings": [f.to_dict() for f in sort_findings(findings)],
+            "counts": counts,
+            "rules": {
+                rid: {"severity": r.severity, "summary": r.summary}
+                for rid, r in sorted(RULES.items())
+            },
+        },
+        indent=2,
+    )
+
+
+def exit_code(findings: List[Finding], fail_on: str = ERROR) -> int:
+    """0 = pass.  fail_on='error' fails only on errors; 'warning' fails
+    on anything."""
+    if fail_on == "warning":
+        return 1 if findings else 0
+    return 1 if any(f.severity == ERROR for f in findings) else 0

@@ -1,0 +1,220 @@
+"""Rule framework for graftlint (the repo-native static-analysis pass).
+
+A :class:`Rule` is a stable ID + severity + rationale; a :class:`Finding`
+is one located violation.  Rule IDs are grouped by pass:
+
+- ``GL0xx`` — meta (suppression hygiene)
+- ``GL1xx`` — JAX trace-safety (sim/, crdt/)
+- ``GL2xx`` — async lock discipline (agent/, swim/, sync/, broadcast/,
+  transport/)
+- ``GL3xx`` — abstract shape/dtype contracts (jax.eval_shape over the
+  sim transition)
+
+Severities: ``error`` findings break the fidelity/correctness contracts
+named in each rule's rationale (doc/lint.md) and fail the build under the
+default ``--fail-on=error``; ``warning`` findings are hygiene that a later
+change can silently upgrade into an error-class defect.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+ERROR = "error"
+WARNING = "warning"
+
+_SEVERITY_ORDER = {ERROR: 0, WARNING: 1}
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    summary: str
+    rationale: str
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    severity: str
+    message: str
+
+    def key(self):
+        return (
+            self.path,
+            self.line,
+            _SEVERITY_ORDER.get(self.severity, 9),
+            self.rule,
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+        }
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def _rule(id: str, severity: str, summary: str, rationale: str) -> Rule:
+    r = Rule(id=id, severity=severity, summary=summary, rationale=rationale)
+    RULES[id] = r
+    return r
+
+
+# -- meta ---------------------------------------------------------------------
+
+GL001 = _rule(
+    "GL001",
+    ERROR,
+    "suppression without a reason",
+    "`# graftlint: disable=RULE` must carry `(reason)` — an unexplained "
+    "suppression hides a finding from the next reader with no trail; the "
+    "suppression is IGNORED until a reason is added.",
+)
+GL002 = _rule(
+    "GL002",
+    WARNING,
+    "suppression names an unknown rule",
+    "A typo'd rule ID suppresses nothing; the finding it meant to cover "
+    "still fires, and the comment rots.",
+)
+
+# -- JAX trace-safety ---------------------------------------------------------
+
+GL101 = _rule(
+    "GL101",
+    ERROR,
+    "Python control flow on a traced value inside a jitted/scanned body",
+    "`if`/`while`/`assert` on a tracer raises TracerBoolConversionError "
+    "under jit — or worse, silently bakes one branch into the compiled "
+    "step, breaking the sim's fidelity bar (±2% round counts vs the CPU "
+    "reference, sim/model.py).  Use lax.cond / jnp.where / the while_loop "
+    "predicate.",
+)
+GL102 = _rule(
+    "GL102",
+    ERROR,
+    "impure call inside a pure (traced) region",
+    "`time.*` / `random.*` / `np.random.*` / `global` mutation inside a "
+    "jitted or scanned body executes ONCE at trace time and is constant "
+    "thereafter — the sim's counter-based RNG (sim/rng.py) exists "
+    "precisely so no host randomness leaks into the tensor program.",
+)
+GL103 = _rule(
+    "GL103",
+    ERROR,
+    "Python int()/float()/bool() coercion of a traced value",
+    "Concretizing a tracer raises ConcretizationTypeError under jit; "
+    "fetch scalars outside the jitted region (see the device-to-host "
+    "fetch notes in sim/cluster.py run()).",
+)
+GL104 = _rule(
+    "GL104",
+    WARNING,
+    "weak float literal mixes into traced integer arithmetic",
+    "A bare Python float in tensor arithmetic promotes the result "
+    "(weak-dtype promotion) — the sim's random path is integer-only by "
+    "contract (sim/rng.py: float math is not bit-identical across "
+    "XLA backends, which would desynchronize sim and CPU reference).",
+)
+GL105 = _rule(
+    "GL105",
+    WARNING,
+    "array creator without an explicit dtype",
+    "`jnp.zeros/ones/full/empty/arange` default dtypes follow the x64 "
+    "flag — the same code builds int32 tensors on one host and int64 on "
+    "another, breaking the no-wide-dtype contract the eval_shape checker "
+    "(GL302) enforces on the sim state.",
+)
+
+# -- async lock discipline ----------------------------------------------------
+
+GL201 = _rule(
+    "GL201",
+    ERROR,
+    "await of network/sleep call while holding a lock",
+    "A lock held across peer I/O serializes the event loop on the "
+    "slowest peer and invites lock-order deadlocks between sync "
+    "sessions, ingestion, and bookkeeping (the reference tracks exactly "
+    "this with its LockRegistry, agent/bookkeeping.py).  Snapshot under "
+    "the lock, send outside it — or suppress with the invariant that "
+    "makes holding it correct.",
+)
+GL202 = _rule(
+    "GL202",
+    WARNING,
+    "shared attribute mutated outside the lock that guards it elsewhere",
+    "An attribute accessed under `async with <lock>` in one coroutine "
+    "and mutated bare in another is only safe while no await point sits "
+    "between read and write; the next refactor that adds one turns this "
+    "into a lost update (the fidelity harness compares against runs "
+    "where these races decide round counts).",
+)
+GL203 = _rule(
+    "GL203",
+    WARNING,
+    "unbounded await on peer I/O",
+    "An await on receive-side peer I/O (recv/read/connect) with no "
+    "timeout lets one stalled peer park a coroutine forever — with a "
+    "semaphore or sync permit held, that's a slow-leak denial of "
+    "service (the reference bounds every peer read, e.g. the 5 s frame "
+    "timeout in bi.rs:62).",
+)
+GL204 = _rule(
+    "GL204",
+    ERROR,
+    "fire-and-forget task: create_task result dropped",
+    "A task whose handle is dropped swallows its exceptions ('Task "
+    "exception was never retrieved' at gc time, long after the cause) "
+    "and cannot be cancelled at shutdown — every task in agent/node.py "
+    "is tracked in _tasks for exactly this reason.",
+)
+
+# -- abstract contracts -------------------------------------------------------
+
+GL301 = _rule(
+    "GL301",
+    ERROR,
+    "sim transition is not shape/dtype-stable round-over-round",
+    "lax.while_loop/scan require carry stability; a drifting shape or "
+    "dtype either fails to compile or silently recompiles per round, "
+    "destroying the <60 s convergence bar (ROADMAP north star).",
+)
+GL302 = _rule(
+    "GL302",
+    ERROR,
+    "wide dtype (float64/int64) in the sim state pytree",
+    "TPUs emulate 64-bit poorly and the CPU/TPU fidelity contract "
+    "(tests/test_sim.py) is defined over 32-bit-or-narrower state; a "
+    "wide leaf doubles HBM for the 100k-node configs too.",
+)
+GL303 = _rule(
+    "GL303",
+    ERROR,
+    "tracer leak or trace-time failure in the sim transition",
+    "The one-round transition must trace cleanly under "
+    "jax.check_tracer_leaks — a leaked tracer means some Python-side "
+    "state captured a traced value, the root cause behind "
+    "use-after-trace crashes.",
+)
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=Finding.key)
+
+
+def worst_severity(findings: List[Finding]) -> Optional[str]:
+    if any(f.severity == ERROR for f in findings):
+        return ERROR
+    if findings:
+        return WARNING
+    return None

@@ -1,0 +1,116 @@
+"""Inline suppression handling.
+
+Syntax (same line as the finding, or alone on the line directly above):
+
+    x = foo()  # graftlint: disable=GL101 (static config branch, p is a dataclass)
+    # graftlint: disable=GL201,GL203 (send_lock serializes one stream writer)
+
+Every suppression MUST carry a parenthesized reason.  A reason-less
+``disable`` does not suppress anything — it instead raises a GL001
+finding of its own, so a suppression can never silently hide a defect
+without leaving a written justification behind.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Set, Tuple
+
+from .rules import RULES, Finding, GL001, GL002
+
+# The reason is everything between the first "(" after the rule list and
+# the LAST ")" on the line, so reasons may themselves contain parens.
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=(?P<rules>[A-Za-z0-9_,\s]+?)"
+    r"(?:\s*\((?P<reason>.*)\))?\s*$"
+)
+
+
+@dataclass
+class Suppression:
+    line: int           # line the comment sits on
+    rules: Tuple[str, ...]
+    reason: str
+    standalone: bool    # comment is the whole line -> applies to line+1
+    used: bool = False
+
+    def covers(self) -> Set[int]:
+        """Lines this suppression applies to."""
+        return {self.line + 1} if self.standalone else {self.line}
+
+
+def scan_suppressions(path: str, source: str) -> Tuple[List[Suppression], List[Finding]]:
+    """Parse all graftlint suppression comments in *source*.
+
+    Returns the usable suppressions plus meta findings (GL001 for missing
+    reasons — those suppressions are dropped — and GL002 for unknown rule
+    IDs).
+    """
+    sups: List[Suppression] = []
+    meta: List[Finding] = []
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rule_ids = tuple(
+            r.strip().upper() for r in m.group("rules").split(",") if r.strip()
+        )
+        reason = (m.group("reason") or "").strip()
+        if not reason:
+            meta.append(
+                Finding(
+                    path=path,
+                    line=lineno,
+                    rule=GL001.id,
+                    severity=GL001.severity,
+                    message=(
+                        "suppression of "
+                        + ",".join(rule_ids)
+                        + " has no reason — add one in parentheses: "
+                        "# graftlint: disable=RULE (why this is safe); "
+                        "the suppression is ignored until then"
+                    ),
+                )
+            )
+            continue
+        for rid in rule_ids:
+            if rid not in RULES:
+                meta.append(
+                    Finding(
+                        path=path,
+                        line=lineno,
+                        rule=GL002.id,
+                        severity=GL002.severity,
+                        message=f"suppression names unknown rule {rid}",
+                    )
+                )
+        standalone = text.strip().startswith("#")
+        sups.append(
+            Suppression(
+                line=lineno, rules=rule_ids, reason=reason, standalone=standalone
+            )
+        )
+    return sups, meta
+
+
+def apply_suppressions(
+    findings: List[Finding], sups: List[Suppression]
+) -> List[Finding]:
+    """Drop findings covered by a (reasoned) suppression for their rule."""
+    by_line: Dict[int, List[Suppression]] = {}
+    for s in sups:
+        for ln in s.covers():
+            by_line.setdefault(ln, []).append(s)
+    kept: List[Finding] = []
+    for f in findings:
+        hit = None
+        for s in by_line.get(f.line, ()):
+            if f.rule in s.rules:
+                hit = s
+                break
+        if hit is None:
+            kept.append(f)
+        else:
+            hit.used = True
+    return kept

@@ -1,0 +1,435 @@
+"""JAX trace-safety pass (GL101–GL105) over ``sim/`` and ``crdt/``.
+
+The central problem is deciding which functions are *pure regions* —
+bodies that run under a JAX trace (jit / scan / while_loop / cond /
+vmap / eval_shape) — and which local names inside them are *traced*.
+The repo's dominant idiom is the factory pattern in ``sim/cluster.py``:
+
+    def make_step(p):          # host code: p is a static dataclass
+        consts = _consts(p)    # host code, eager
+        def step(state):       # PURE: passed to lax.while_loop/scan
+            cov, budget, ... = state          # traced
+            def death(...): ...               # PURE: nested in step
+            if p.swim: ...                    # fine: p is static
+            ...
+        return step
+
+so purity seeds from *call sites* (the argument positions of
+``jax.jit(f)``, ``lax.scan(f, ...)``, ``partial(jax.jit, ...)`` and
+friends, plus ``@jit``-style decorators), then propagates through
+nested ``def``s and through calls to sibling functions by bare name.
+Traced names seed from a pure function's parameters and propagate
+through assignments; attribute chains rooted at a traced name are
+treated as *static* (``p.swim`` must not flag even when ``p`` is
+mis-inferred), trading a little recall for near-zero false positives —
+the right trade for a lint gate that must exit 0 on every commit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .rules import Finding, GL101, GL102, GL103, GL104, GL105
+
+# Names that mark the callable in their first argument as traced-pure.
+_TRACING_ENTRY_POINTS = {
+    "jit",
+    "pjit",
+    "vmap",
+    "pmap",
+    "grad",
+    "value_and_grad",
+    "eval_shape",
+    "scan",
+    "while_loop",
+    "fori_loop",
+    "cond",
+    "switch",
+    "checkpoint",
+    "remat",
+    "custom_jvp",
+    "custom_vjp",
+}
+
+# Module roots whose calls are impure inside a traced body (GL102).
+_IMPURE_ROOTS = {"time", "random"}
+_IMPURE_NP_RANDOM = ("np", "numpy")
+
+# Python builtins that concretize a tracer (GL103).
+_COERCIONS = {"int", "float", "bool", "complex"}
+
+# Array creators that should always pass an explicit dtype (GL105).
+_DTYPE_CREATORS = {"zeros", "ones", "full", "empty", "arange", "eye"}
+# Positional index of dtype for each creator (jnp signature order).
+_DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2, "eye": 1, "arange": None}
+
+
+def _func_name(node: ast.expr) -> Optional[str]:
+    """Trailing name of a call target: jax.jit -> 'jit', jit -> 'jit'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root_name(node: ast.expr) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _callables_in_call(call: ast.Call) -> List[ast.expr]:
+    """Expressions passed where a traced callable is expected.
+
+    For scan/while_loop/cond/switch every function-ish argument is a
+    traced body; for jit/vmap only the first argument is.  We keep it
+    simple and collect *all* Name/Lambda arguments plus ``partial(...)``
+    wrappers — over-approximating purity is safe here because purity
+    only enables checks, and a host function mistakenly marked pure
+    would have to ALSO trip a rule to produce a false positive.
+    """
+    out: List[ast.expr] = []
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(arg, (ast.Lambda, ast.Name)):
+            out.append(arg)
+        elif isinstance(arg, ast.Call):
+            fname = _func_name(arg.func)
+            if fname == "partial":
+                out.extend(
+                    a for a in arg.args if isinstance(a, (ast.Name, ast.Lambda))
+                )
+    return out
+
+
+class _FunctionIndex(ast.NodeVisitor):
+    """Map function name -> def node, and record lexical nesting."""
+
+    def __init__(self):
+        self.defs: Dict[str, List[ast.FunctionDef]] = {}
+        self.children: Dict[ast.AST, List[ast.FunctionDef]] = {}
+        self._stack: List[ast.AST] = []
+
+    def _visit_def(self, node):
+        self.defs.setdefault(node.name, []).append(node)
+        if self._stack:
+            self.children.setdefault(self._stack[-1], []).append(node)
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+
+def _collect_pure_functions(tree: ast.Module) -> Set[ast.FunctionDef]:
+    """Worklist: seed from tracing call sites + decorators, then close
+    over (a) nested defs and (b) bare-name calls from pure bodies."""
+    index = _FunctionIndex()
+    index.visit(tree)
+
+    pure: Set[ast.FunctionDef] = set()
+    work: List[ast.FunctionDef] = []
+
+    def mark(fn: ast.AST):
+        if isinstance(fn, ast.FunctionDef) and fn not in pure:
+            pure.add(fn)
+            work.append(fn)
+
+    def mark_name(name: str):
+        for fn in index.defs.get(name, ()):
+            mark(fn)
+
+    # Seeds: decorators and tracing-entry-point call arguments.
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _func_name(target) in _TRACING_ENTRY_POINTS:
+                    mark(node)
+                # @partial(jax.jit, static_argnums=...) idiom
+                if (
+                    isinstance(dec, ast.Call)
+                    and _func_name(dec.func) == "partial"
+                    and dec.args
+                    and _func_name(dec.args[0]) in _TRACING_ENTRY_POINTS
+                ):
+                    mark(node)
+        elif isinstance(node, ast.Call):
+            if _func_name(node.func) in _TRACING_ENTRY_POINTS:
+                for c in _callables_in_call(node):
+                    if isinstance(c, ast.Name):
+                        mark_name(c.id)
+                    # Lambdas are traced bodies too: any bare name they
+                    # call becomes pure.
+                    elif isinstance(c, ast.Lambda):
+                        for sub in ast.walk(c.body):
+                            if isinstance(sub, ast.Call) and isinstance(
+                                sub.func, ast.Name
+                            ):
+                                mark_name(sub.func.id)
+
+    # Closure: nested defs of a pure fn are pure; bare-name callees of a
+    # pure body are pure.
+    while work:
+        fn = work.pop()
+        for child in index.children.get(fn, ()):
+            mark(child)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                mark_name(node.func.id)
+    return pure
+
+
+class _TracedNames:
+    """Per-function traced-name inference.
+
+    Parameters of a pure function are traced (JAX passes operands
+    positionally).  Assignments propagate tracedness from any traced
+    name on the RHS; ``jnp.*``/``lax.*`` call results whose arguments
+    include a traced name are traced.  Attribute chains are STATIC
+    unless the full chain root is itself a plain traced Name used
+    bare — i.e. ``state[0]`` is traced if ``state`` is, ``p.swim``
+    is not traced even if ``p`` were.
+    """
+
+    # Host-scalar annotations mark a parameter as STATIC: the repo's
+    # convention for trace-time-constant ints threaded into pure bodies
+    # (attempt/slot indices in sim/cluster.py's draw functions).
+    _STATIC_ANNOTATIONS = {"int", "bool", "str"}
+
+    def __init__(self, fn: ast.FunctionDef):
+        self.names: Set[str] = set()
+        args = fn.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            ann = a.annotation
+            if isinstance(ann, ast.Name) and ann.id in self._STATIC_ANNOTATIONS:
+                continue
+            self.names.add(a.arg)
+        if args.vararg:
+            self.names.add(args.vararg.arg)
+        # Fixed point over assignments (bodies are small; 2 passes is
+        # plenty in practice but iterate until stable to be safe).
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and self.expr_traced(node.value):
+                    for tgt in node.targets:
+                        for leaf in self._target_names(tgt):
+                            if leaf not in self.names:
+                                self.names.add(leaf)
+                                changed = True
+                elif isinstance(node, ast.AugAssign) and self.expr_traced(node.value):
+                    for leaf in self._target_names(node.target):
+                        if leaf not in self.names:
+                            self.names.add(leaf)
+                            changed = True
+
+    @staticmethod
+    def _target_names(tgt: ast.expr) -> List[str]:
+        if isinstance(tgt, ast.Name):
+            return [tgt.id]
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            out = []
+            for elt in tgt.elts:
+                out.extend(_TracedNames._target_names(elt))
+            return out
+        return []
+
+    def expr_traced(self, node: ast.expr) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in self.names:
+                # Exclude names that only appear as the root of an
+                # attribute access — handled by the parent walk below.
+                return not self._only_attribute_root(node, sub)
+        return False
+
+    @staticmethod
+    def _only_attribute_root(tree: ast.expr, name: ast.Name) -> bool:
+        """True if *name* appears in *tree* solely as ``name.attr...``."""
+        for sub in ast.walk(tree):
+            if isinstance(sub, ast.Attribute) and sub.value is name:
+                return True
+        return False
+
+
+class _PureBodyChecker(ast.NodeVisitor):
+    """Run GL101–GL105 inside one pure function body."""
+
+    def __init__(self, path: str, fn: ast.FunctionDef, pure: Set[ast.FunctionDef]):
+        self.path = path
+        self.fn = fn
+        self.pure = pure
+        self.traced = _TracedNames(fn)
+        self.findings: List[Finding] = []
+
+    def _emit(self, rule, node: ast.AST, message: str):
+        self.findings.append(
+            Finding(
+                path=self.path,
+                line=node.lineno,
+                rule=rule.id,
+                severity=rule.severity,
+                message=message,
+            )
+        )
+
+    def run(self) -> List[Finding]:
+        for stmt in self.fn.body:
+            self.visit(stmt)
+        return self.findings
+
+    # Don't descend into nested defs: they are checked as their own
+    # pure regions (with their own parameter seeds).
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- GL101: host control flow on traced values ------------------------
+
+    def visit_If(self, node: ast.If):
+        if self.traced.expr_traced(node.test):
+            self._emit(
+                GL101,
+                node,
+                "`if` on a traced value inside a jitted/scanned body — "
+                "use lax.cond or jnp.where",
+            )
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        if self.traced.expr_traced(node.test):
+            self._emit(
+                GL101,
+                node,
+                "`while` on a traced value inside a jitted/scanned body — "
+                "use lax.while_loop",
+            )
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert):
+        if self.traced.expr_traced(node.test):
+            self._emit(
+                GL101,
+                node,
+                "`assert` on a traced value inside a jitted/scanned body — "
+                "use checkify or move the check outside the trace",
+            )
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp):
+        if self.traced.expr_traced(node.test):
+            self._emit(
+                GL101,
+                node,
+                "conditional expression on a traced value — use jnp.where",
+            )
+        self.generic_visit(node)
+
+    # -- GL102: impurity --------------------------------------------------
+
+    def visit_Global(self, node: ast.Global):
+        self._emit(
+            GL102,
+            node,
+            "`global` mutation inside a pure region runs once at trace "
+            "time; thread the value through the carry instead",
+        )
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            root = _root_name(func)
+            if root in _IMPURE_ROOTS:
+                self._emit(
+                    GL102,
+                    node,
+                    f"call to {root}.{func.attr} inside a pure region "
+                    "executes at trace time only — use the counter-based "
+                    "RNG (sim/rng.py) or pass the value in",
+                )
+            elif (
+                root in _IMPURE_NP_RANDOM
+                and isinstance(func.value, ast.Attribute)
+                and func.value.attr == "random"
+            ):
+                self._emit(
+                    GL102,
+                    node,
+                    f"np.random.{func.attr} inside a pure region is "
+                    "trace-time-constant host randomness — use sim/rng.py",
+                )
+        # -- GL103: tracer coercion --
+        elif isinstance(func, ast.Name) and func.id in _COERCIONS:
+            if node.args and self.traced.expr_traced(node.args[0]):
+                self._emit(
+                    GL103,
+                    node,
+                    f"{func.id}() of a traced value concretizes the tracer "
+                    "— fetch scalars outside the jitted region",
+                )
+        # -- GL105: dtype-less creators --
+        fname = _func_name(func)
+        if (
+            isinstance(func, ast.Attribute)
+            and _root_name(func) in ("jnp", "jax", "np", "numpy")
+            and fname in _DTYPE_CREATORS
+        ):
+            has_dtype = any(kw.arg == "dtype" for kw in node.keywords)
+            pos = _DTYPE_POS.get(fname)
+            if not has_dtype and pos is not None and len(node.args) > pos:
+                has_dtype = True
+            if not has_dtype:
+                self._emit(
+                    GL105,
+                    node,
+                    f"{fname}() without an explicit dtype follows the x64 "
+                    "flag — pass dtype=jnp.int32/float32 explicitly",
+                )
+        self.generic_visit(node)
+
+    # -- GL104: weak float literals in traced arithmetic ------------------
+
+    def visit_BinOp(self, node: ast.BinOp):
+        sides = (node.left, node.right)
+        has_float = any(
+            isinstance(s, ast.Constant) and isinstance(s.value, float)
+            for s in sides
+        )
+        other_traced = any(
+            self.traced.expr_traced(s)
+            for s in sides
+            if not isinstance(s, ast.Constant)
+        )
+        if has_float and other_traced:
+            self._emit(
+                GL104,
+                node,
+                "bare float literal in traced arithmetic weak-promotes the "
+                "result — wrap it: jnp.float32(x) or use integer math",
+            )
+        self.generic_visit(node)
+
+
+def check_source(path: str, source: str) -> List[Finding]:
+    """Run the trace-safety pass over one file's source."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                path=path,
+                line=e.lineno or 1,
+                rule=GL101.id,
+                severity="error",
+                message=f"file does not parse: {e.msg}",
+            )
+        ]
+    findings: List[Finding] = []
+    pure = _collect_pure_functions(tree)
+    for fn in pure:
+        findings.extend(_PureBodyChecker(path, fn, pure).run())
+    return findings

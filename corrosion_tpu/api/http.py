@@ -22,10 +22,9 @@ corro-api-types lib.rs:27-66): ``{"columns": [...]}}``, ``{"row": [rowid,
 
 from __future__ import annotations
 
-import asyncio
 import json
 import time
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 from aiohttp import web
 

@@ -58,6 +58,31 @@ def api_base(config: Config) -> str:
 # -- subcommand implementations ---------------------------------------------
 
 
+def _self_check() -> None:
+    """Run graftlint over the shipped tree and record the finding counts
+    as ``lint_findings_total{severity}`` (utils/metrics.py) so a deployed
+    agent reports its own build hygiene.  Never blocks boot: a finding is
+    a metric, not a crash."""
+    from ..analysis import lint_repo, severity_counts
+    from ..utils.metrics import counter
+
+    try:
+        findings = lint_repo()
+    except Exception as e:  # noqa: BLE001 — self-check must not kill the agent
+        counter("lint.findings.total", severity="selfcheck_error").inc()
+        print(f"self-check failed to run: {e}", file=sys.stderr)
+        return
+    counts = severity_counts(findings)
+    for severity in ("error", "warning"):
+        counter("lint.findings.total", severity=severity).inc(
+            counts.get(severity, 0)
+        )
+    print(
+        f"self-check: {counts.get('error', 0)} error(s), "
+        f"{counts.get('warning', 0)} warning(s)"
+    )
+
+
 async def cmd_agent(args) -> int:
     import os
     import socket as socketmod
@@ -67,6 +92,8 @@ async def cmd_agent(args) -> int:
 
     config = load_config(args)
     setup_logging(config.log)
+    if getattr(args, "self_check", False):
+        _self_check()
     gossip_socks = None
     inherited = os.environ.get("CORRO_GOSSIP_FDS")
     if inherited:
@@ -365,6 +392,23 @@ async def cmd_tls(args) -> int:
     return 0
 
 
+async def cmd_lint(args) -> int:
+    from ..analysis import (
+        exit_code,
+        lint_paths,
+        lint_repo,
+        render_json,
+        render_text,
+    )
+
+    if args.paths:
+        findings = lint_paths(args.paths)
+    else:
+        findings = lint_repo(with_contracts=not args.no_contracts)
+    print(render_json(findings) if args.json else render_text(findings))
+    return exit_code(findings, fail_on=args.fail_on)
+
+
 def _cell_str(cell: Any) -> str:
     if cell is None:
         return ""
@@ -390,9 +434,37 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = p.add_subparsers(dest="command", required=True)
 
-    sub.add_parser("agent", help="run the node daemon").set_defaults(
-        fn=cmd_agent
+    sp = sub.add_parser("agent", help="run the node daemon")
+    sp.add_argument(
+        "--self-check",
+        action="store_true",
+        help="run graftlint at boot and publish lint_findings_total metrics",
     )
+    sp.set_defaults(fn=cmd_agent)
+
+    sp = sub.add_parser(
+        "lint",
+        help="graftlint: JAX trace-safety, async lock discipline, and "
+        "eval_shape contracts (doc/lint.md)",
+    )
+    sp.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to lint (default: the shipped tree + contracts)",
+    )
+    sp.add_argument("--json", action="store_true", help="machine-readable output")
+    sp.add_argument(
+        "--fail-on",
+        choices=("error", "warning"),
+        default="error",
+        help="exit 1 on this severity or worse (default: error)",
+    )
+    sp.add_argument(
+        "--no-contracts",
+        action="store_true",
+        help="skip the jax.eval_shape contract pass (pure-AST mode, no jax)",
+    )
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("backup", help="snapshot the database")
     sp.add_argument("path")

@@ -10,13 +10,20 @@ used so the 3.40 runtime is fine.
 from __future__ import annotations
 
 import os
-import sys
+import site
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(HERE, "src", "crsqlite.cpp")
 OUT = os.path.join(HERE, "crsqlite.so")
 
+# The running interpreter's site-packages first: the tensorflow wheel
+# location follows the python version, so a fixed path only works in the
+# venv it was written for.
 _INCLUDE_CANDIDATES = [
+    *(
+        os.path.join(sp, "tensorflow", "include", "external", "org_sqlite")
+        for sp in site.getsitepackages()
+    ),
     "/opt/venv/lib/python3.12/site-packages/tensorflow/include/external/org_sqlite",
     "/usr/include",
 ]

@@ -692,13 +692,16 @@ static int as_crr_impl(Crsql *p, const std::string &table, char **err) {
 
   // clock table — shape matches the reference migration
   // (crates/corro-types/src/agent.rs:274-283)
+  // STRICT needs sqlite >= 3.37; the typed column affinities above are
+  // correct either way, so older runtimes just lose the extra type check.
   rc = exec_fmt(p->db, err,
                 "CREATE TABLE IF NOT EXISTS \"%w__crsql_clock\" (key INTEGER "
                 "NOT NULL, col_name TEXT NOT NULL, col_version INTEGER NOT "
                 "NULL, db_version INTEGER NOT NULL, site_id INTEGER NOT NULL "
                 "DEFAULT 0, seq INTEGER NOT NULL, PRIMARY KEY (key, "
-                "col_name)) WITHOUT ROWID, STRICT",
-                table.c_str());
+                "col_name)) WITHOUT ROWID%s",
+                table.c_str(),
+                sqlite3_libversion_number() >= 3037000 ? ", STRICT" : "");
   if (rc != SQLITE_OK) return rc;
   rc = exec_fmt(p->db, err,
                 "CREATE INDEX IF NOT EXISTS \"%w__crsql_clock_dbv_idx\" ON "

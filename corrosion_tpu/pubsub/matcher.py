@@ -32,13 +32,13 @@ import threading
 import time
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..types.change import SqliteValue, jsonify_cell as _encode_cell
 from ..types.columns import pack_columns
 from ..utils.metrics import counter
 from . import sql as sqlmod
-from .sql import MatcherError, ParsedSelect, pk_alias
+from .sql import MatcherError, ParsedSelect
 
 logger = logging.getLogger(__name__)
 

@@ -265,7 +265,7 @@ def make_step(p: SimParams):
             # fidelity experiments replay these exact draws)
             down2 = status == DOWN  # [2, N] per side, or [N, N] per node
 
-            def probe_draw(a):
+            def probe_draw(a: int):
                 suffix = () if a == 0 else (a,)
                 t = jx_below(N - 1, p.seed, TAG_PROBE, r, narange, *suffix)
                 return t + (t >= narange)
@@ -461,7 +461,7 @@ def make_step(p: SimParams):
         # 5. anti-entropy: budgeted needs-based pull from one peer
         if p.sync_interval > 0:
 
-            def sync_draw(a):
+            def sync_draw(a: int):
                 suffix = () if a == 0 else (a,)
                 q = jx_below(N - 1, p.seed, TAG_SYNC, r, narange, *suffix)
                 return q + (q >= narange)

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from .model import SimParams
-from .rng import TAG_INJECT, TAG_ORIGIN, jx_below, py_below
+from .rng import TAG_INJECT, jx_below, py_below
 
 TAG_KEY = 9
 
@@ -48,7 +48,9 @@ def merge_registers(
     """
     K = p.n_changes
     keys = change_keys(p, n_keys)
-    lamport = jx_below(p.write_rounds, p.seed, TAG_INJECT, jnp.arange(K))
+    lamport = jx_below(
+        p.write_rounds, p.seed, TAG_INJECT, jnp.arange(K, dtype=jnp.int32)
+    )
     pack = lamport.astype(jnp.int32) * K + jnp.arange(K, dtype=jnp.int32)
 
     def per_node(h):

@@ -33,7 +33,7 @@ ever believed down are bit-identical to runs without SWIM modeling.
 
 from __future__ import annotations
 
-from typing import Sequence, Union
+from typing import Union
 
 import jax.numpy as jnp
 

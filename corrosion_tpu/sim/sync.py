@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .model import SimParams
-from .rng import TAG_NSEQ, TAG_ORIGIN, jx_below, py_below
+from .rng import TAG_NSEQ, TAG_ORIGIN, py_below
 
 # -- chunk-shape constants (static per SimParams) ---------------------------
 

@@ -32,7 +32,7 @@ Piggyback entries: (actor_tuple, state, incarnation) with state in
 from __future__ import annotations
 
 import random
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from ..types.actor import Actor, ActorId

@@ -200,7 +200,7 @@ class SyncServer:
                 ]
             for crange in cleared:
                 async with send_lock:
-                    await fs.send(
+                    await fs.send(  # graftlint: disable=GL201 (send_lock serializes frame writes on the shared sync stream; frames must not interleave)
                         wire.encode_sync_changeset(
                             ChangeV1(
                                 actor_id=actor_id,
@@ -296,7 +296,7 @@ class SyncServer:
                 )
         else:  # Cleared
             async with send_lock:
-                await fs.send(
+                await fs.send(  # graftlint: disable=GL201 (send_lock serializes frame writes on the shared sync stream; frames must not interleave)
                     wire.encode_sync_changeset(
                         ChangeV1(
                             actor_id=actor_id,
@@ -332,7 +332,7 @@ class SyncServer:
         for chunk, seq_range in chunker:
             t0 = time.monotonic()
             async with send_lock:
-                await fs.send(
+                await fs.send(  # graftlint: disable=GL201 (send_lock serializes frame writes on the shared sync stream; frames must not interleave)
                     wire.encode_sync_changeset(
                         ChangeV1(
                             actor_id=actor_id,

@@ -137,7 +137,7 @@ class FramedStream:
                     self._stats["stream_bytes_recv"] += consumed
                 return payload
             if deadline is None:
-                chunk = await self.reader.read(65536)
+                chunk = await self.reader.read(65536)  # graftlint: disable=GL203 (deadline=None is the caller-opted unbounded path; recv timeout= is the bounded one)
             else:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -280,7 +280,7 @@ class Transport:
         peer = writer.get_extra_info("peername")
         addr = (peer[0], peer[1]) if peer else ("?", 0)
         try:
-            magic = await reader.readexactly(1)
+            magic = await reader.readexactly(1)  # graftlint: disable=GL203 (accept path; one magic byte before the conn is registered, closed on error)
         except (asyncio.IncompleteReadError, ConnectionError):
             writer.close()
             return
@@ -290,7 +290,7 @@ class Transport:
         try:
             if magic == UNI_MAGIC:
                 while True:
-                    payload = await fs.recv()
+                    payload = await fs.recv()  # graftlint: disable=GL203 (long-lived inbound uni stream; idle between frames is normal, close() unblocks it)
                     if payload is None:
                         break
                     if self.on_uni_frame is not None:
@@ -314,10 +314,10 @@ class Transport:
 
     async def _open_stream(self, addr: Addr):
         if self.ssl_client is not None:
-            return await asyncio.open_connection(
+            return await asyncio.open_connection(  # graftlint: disable=GL203 (connect bounded by the OS TCP timeout; callers retry via send_uni's drop-and-redial)
                 *addr, ssl=self.ssl_client, server_hostname=addr[0]
             )
-        return await asyncio.open_connection(*addr)
+        return await asyncio.open_connection(*addr)  # graftlint: disable=GL203 (connect bounded by the OS TCP timeout; callers retry via send_uni's drop-and-redial)
 
     async def _connect_uni(self, addr: Addr) -> FramedStream:
         t0 = time.monotonic()
@@ -339,14 +339,14 @@ class Transport:
             if fs is None:
                 fs = await self._connect_uni(addr)
             try:
-                await fs.send(payload)
+                await fs.send(payload)  # graftlint: disable=GL201 (per-peer lock exists to serialize writes on this cached stream)
             except (ConnectionError, OSError):
                 # stale cached conn: drop it and retry once fresh
                 self._stats["conns_dropped"] += 1
                 fs.close()
                 self._uni_conns.pop(addr, None)
                 fs = await self._connect_uni(addr)
-                await fs.send(payload)
+                await fs.send(payload)  # graftlint: disable=GL201 (per-peer lock exists to serialize writes on this cached stream)
 
     async def flush(self, timeout: float = 30.0) -> None:
         """Send-completion barrier (API parity with NativeTransport.flush).

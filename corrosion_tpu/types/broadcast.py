@@ -16,8 +16,8 @@ Changesets come in two shapes (broadcast.rs:30-124):
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Optional, Tuple
 
 from .actor import ActorId
 from .change import Change

@@ -10,7 +10,11 @@ perf / admin / telemetry (config.rs:35-54), loadable from TOML with
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: same API via the tomli backport
+    import tomli as tomllib
 from dataclasses import dataclass, field, fields
 from typing import Any, Dict, List, Optional
 

@@ -25,7 +25,7 @@ from __future__ import annotations
 import re
 import sqlite3
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 RESERVED_PREFIXES = ("__corro", "sqlite_", "crsql")
 

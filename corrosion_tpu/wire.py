@@ -19,7 +19,7 @@ validates shape and rebuilds the dataclasses.
 from __future__ import annotations
 
 import struct
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import msgpack
 

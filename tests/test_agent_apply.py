@@ -7,7 +7,6 @@ partial buffering + gap-free flush, empty-changeset compaction
 
 import asyncio
 
-import pytest
 
 from corrosion_tpu.agent import (
     Agent,

@@ -2,7 +2,6 @@
 and the lock-aware byte-level restore in crates/sqlite3-restore/)."""
 
 import asyncio
-import os
 import sqlite3
 
 import pytest

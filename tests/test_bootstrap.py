@@ -11,7 +11,6 @@ from corrosion_tpu.agent import bootstrap
 from corrosion_tpu.agent.bootstrap import (
     QTYPE_A,
     dns_resolve,
-    generate_bootstrap,
     parse_spec,
     resolve_spec,
 )

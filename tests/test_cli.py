@@ -1,10 +1,8 @@
 """CLI integration tests (ref: integration-tests/tests/cli_test.rs — drive
 the real binary against a live agent; command table main.rs:578-653)."""
 
-import asyncio
 import json
 import os
-import socket
 import subprocess
 import sys
 import time

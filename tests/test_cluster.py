@@ -7,9 +7,7 @@ late-joiner anti-entropy catch-up.
 """
 
 import asyncio
-import json
 
-import pytest
 from aiohttp import ClientSession
 
 from corrosion_tpu.agent.node import Node

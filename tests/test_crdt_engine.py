@@ -8,7 +8,6 @@ crates/corro-agent/src/agent/tests.rs).
 
 import random
 
-import pytest
 
 from corrosion_tpu.crdt import connect
 from corrosion_tpu.types.columns import pack_columns, unpack_columns

@@ -9,7 +9,7 @@ from aiohttp import ClientSession
 
 from corrosion_tpu.agent import Agent, AgentConfig
 from corrosion_tpu.api.http import Api
-from corrosion_tpu.types.schema import SchemaError, apply_schema, parse_schema, constrain
+from corrosion_tpu.types.schema import SchemaError, parse_schema, constrain
 
 SCHEMA = [
     'CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;'

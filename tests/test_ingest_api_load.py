@@ -11,7 +11,6 @@ import asyncio
 import types
 import uuid
 
-import pytest
 from aiohttp import ClientSession, web
 
 from corrosion_tpu.agent.agent import Agent, AgentConfig

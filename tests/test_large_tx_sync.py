@@ -13,9 +13,9 @@ tier is the complete 65 000-row / 101-transaction port (tests.rs:605-731).
 import asyncio
 
 import pytest
-from aiohttp import ClientSession
+from aiohttp import ClientSession, ClientTimeout
 
-from tests.test_cluster import SCHEMA, boot_node, wait_for
+from tests.test_cluster import boot_node, wait_for
 
 BIG_TX_ROWS = 10_000  # ref: the one 10k-row changeset (tests.rs:608)
 
@@ -23,7 +23,9 @@ BIG_TX_ROWS = 10_000  # ref: the one 10k-row changeset (tests.rs:608)
 async def _large_tx_sync(total_rows: int, small_tx_rows: int, timeout: float):
     n1 = await boot_node()
     try:
-        async with ClientSession() as http:
+        # cap each request at the test's own sync bound: a stalled write
+        # should fail the test in `timeout` seconds, not aiohttp's 300 s
+        async with ClientSession(timeout=ClientTimeout(total=timeout)) as http:
             # one big multi-chunk version
             stmts = [
                 ["INSERT INTO tests (id,text) VALUES (?,?)", [i, f"big{i:06d}" * 4]]

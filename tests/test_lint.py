@@ -1,0 +1,446 @@
+"""graftlint (corrosion_tpu/analysis/) — fixture snippets per rule, the
+shipped-repo-is-clean self-check, and the eval_shape contract bar.
+
+Each fixture is a minimal known-bad snippet the rule must catch, paired
+with a known-good twin it must NOT flag (false-positive guard: the lint
+gate has to exit 0 on every commit, so precision is part of the spec).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from corrosion_tpu.analysis import (
+    async_discipline,
+    lint_repo,
+    trace_safety,
+)
+from corrosion_tpu.analysis.report import exit_code, render_json
+from corrosion_tpu.analysis.rules import RULES
+from corrosion_tpu.analysis.suppress import apply_suppressions, scan_suppressions
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def trace_rules(src):
+    return {f.rule for f in trace_safety.check_source("fix.py", src)}
+
+
+def async_rules(src):
+    return {f.rule for f in async_discipline.check_source("fix.py", src)}
+
+
+# -- GL101: tracer branching -------------------------------------------------
+
+def test_gl101_if_on_traced_value():
+    bad = """
+import jax
+def step(x):
+    if x > 0:
+        return x
+    return -x
+out = jax.jit(step)
+"""
+    assert "GL101" in trace_rules(bad)
+
+
+def test_gl101_while_and_assert():
+    bad = """
+from jax import lax
+def body(carry):
+    while carry:
+        carry = carry - 1
+    assert carry == 0
+    return carry
+lax.while_loop(lambda c: c > 0, body, 10)
+"""
+    assert "GL101" in trace_rules(bad)
+
+
+def test_gl101_good_static_branch_not_flagged():
+    # `p.swim` is an attribute of a static params object — the dominant
+    # make_step idiom; must not flag even though `state` is traced.
+    good = """
+import jax
+def make_step(p):
+    def step(state):
+        if p.swim:
+            state = state + 1
+        return state
+    return jax.jit(step)
+"""
+    assert trace_rules(good) == set()
+
+
+def test_gl101_static_annotated_param_not_flagged():
+    # `: int` marks a host-scalar (trace-time-constant) parameter — the
+    # sim/cluster.py draw-function convention.
+    good = """
+import jax
+def step(state):
+    def draw(a: int):
+        suffix = () if a == 0 else (a,)
+        return state[0] + len(suffix)
+    return draw(0) + draw(1)
+jax.jit(step)
+"""
+    assert trace_rules(good) == set()
+
+
+# -- GL102: impure calls in pure regions -------------------------------------
+
+def test_gl102_time_and_nprandom():
+    bad = """
+import time, jax
+import numpy as np
+def step(x):
+    t = time.monotonic()
+    r = np.random.uniform()
+    return x + t + r
+jax.jit(step)
+"""
+    assert "GL102" in trace_rules(bad)
+
+
+def test_gl102_global_mutation():
+    bad = """
+import jax
+counter = 0
+def step(x):
+    global counter
+    counter += 1
+    return x
+jax.jit(step)
+"""
+    assert "GL102" in trace_rules(bad)
+
+
+def test_gl102_host_code_not_flagged():
+    good = """
+import time
+def run():
+    t0 = time.perf_counter()
+    return time.perf_counter() - t0
+"""
+    assert trace_rules(good) == set()
+
+
+# -- GL103: tracer coercion --------------------------------------------------
+
+def test_gl103_int_of_tracer():
+    bad = """
+import jax
+def step(x):
+    return int(x)
+jax.jit(step)
+"""
+    assert "GL103" in trace_rules(bad)
+
+
+def test_gl103_int_of_static_not_flagged():
+    good = """
+import jax
+def make_step(p):
+    def step(x):
+        n = int(p.n_nodes)
+        return x + n
+    return jax.jit(step)
+"""
+    assert trace_rules(good) == set()
+
+
+# -- GL104: weak float literals ----------------------------------------------
+
+def test_gl104_weak_float_literal():
+    bad = """
+import jax
+def step(x):
+    return x * 0.5
+jax.jit(step)
+"""
+    assert "GL104" in trace_rules(bad)
+
+
+def test_gl104_int_literal_not_flagged():
+    good = """
+import jax
+def step(x):
+    return x * 2
+jax.jit(step)
+"""
+    assert trace_rules(good) == set()
+
+
+# -- GL105: dtype-less creators ----------------------------------------------
+
+def test_gl105_dtypeless_arange():
+    bad = """
+import jax, jax.numpy as jnp
+def step(x):
+    return x + jnp.arange(8)
+jax.jit(step)
+"""
+    assert "GL105" in trace_rules(bad)
+
+
+def test_gl105_explicit_dtype_not_flagged():
+    good = """
+import jax, jax.numpy as jnp
+def step(x):
+    a = jnp.arange(8, dtype=jnp.int32)
+    b = jnp.zeros((4,), jnp.int32)
+    return x + a.sum() + b.sum()
+jax.jit(step)
+"""
+    assert trace_rules(good) == set()
+
+
+# -- GL201: await under lock -------------------------------------------------
+
+def test_gl201_send_under_lock():
+    bad = """
+import asyncio
+class S:
+    async def go(self, fs):
+        async with self._lock:
+            await fs.send(b"x")
+"""
+    assert "GL201" in async_rules(bad)
+
+
+def test_gl201_send_outside_lock_not_flagged():
+    good = """
+import asyncio
+class S:
+    async def go(self, fs):
+        async with self._lock:
+            payload = self.buf.pop()
+        await fs.send(payload)
+"""
+    assert "GL201" not in async_rules(good)
+
+
+def test_gl201_rwlock_ctx_detected():
+    # CountedRwLock idiom from agent/bookkeeping.py: booked.write(label)
+    bad = """
+import asyncio
+class S:
+    async def go(self, booked, fs):
+        async with booked.write("label"):
+            await asyncio.sleep(1)
+"""
+    assert "GL201" in async_rules(bad)
+
+
+# -- GL203: unbounded peer I/O -----------------------------------------------
+
+def test_gl203_unbounded_recv():
+    bad = """
+class S:
+    async def go(self, fs):
+        return await fs.recv()
+"""
+    assert "GL203" in async_rules(bad)
+
+
+def test_gl203_timeout_kwarg_not_flagged():
+    good = """
+class S:
+    async def go(self, fs):
+        return await fs.recv(timeout=5.0)
+"""
+    assert "GL203" not in async_rules(good)
+
+
+# -- GL204: dropped create_task ----------------------------------------------
+
+def test_gl204_fire_and_forget():
+    bad = """
+import asyncio
+class S:
+    async def go(self):
+        asyncio.create_task(self.work())
+"""
+    assert "GL204" in async_rules(bad)
+
+
+def test_gl204_tracked_task_not_flagged():
+    good = """
+import asyncio
+class S:
+    async def go(self):
+        t = asyncio.create_task(self.work())
+        self._tasks.append(t)
+"""
+    assert "GL204" not in async_rules(good)
+
+
+# -- suppressions ------------------------------------------------------------
+
+def test_suppression_with_reason_suppresses():
+    src = """
+class S:
+    async def go(self, fs):
+        return await fs.recv()  # graftlint: disable=GL203 (long-lived stream; close() unblocks)
+"""
+    findings = async_discipline.check_source("fix.py", src)
+    sups, meta = scan_suppressions("fix.py", src)
+    assert apply_suppressions(findings, sups) == [] and meta == []
+
+
+def test_suppression_without_reason_is_gl001_and_ignored():
+    src = """
+class S:
+    async def go(self, fs):
+        return await fs.recv()  # graftlint: disable=GL203
+"""
+    findings = async_discipline.check_source("fix.py", src)
+    sups, meta = scan_suppressions("fix.py", src)
+    kept = apply_suppressions(findings, sups)
+    # the finding survives AND a GL001 error is raised
+    assert any(f.rule == "GL203" for f in kept)
+    assert any(f.rule == "GL001" for f in meta)
+
+
+def test_suppression_unknown_rule_is_gl002():
+    _, meta = scan_suppressions(
+        "fix.py", "x = 1  # graftlint: disable=GL999 (whatever)\n"
+    )
+    assert any(f.rule == "GL002" for f in meta)
+
+
+def test_standalone_suppression_covers_next_line():
+    src = """
+class S:
+    async def go(self, fs):
+        # graftlint: disable=GL203 (reason here)
+        return await fs.recv()
+"""
+    findings = async_discipline.check_source("fix.py", src)
+    sups, _ = scan_suppressions("fix.py", src)
+    assert apply_suppressions(findings, sups) == []
+
+
+# -- contracts (eval_shape, abstract — no execution) -------------------------
+
+def test_contract_checker_clean_at_all_probe_sizes():
+    from corrosion_tpu.analysis import contracts
+
+    assert contracts.check_transition() == []
+
+
+def test_contract_checker_100k_under_10s():
+    from corrosion_tpu.analysis import contracts
+
+    t0 = time.monotonic()
+    findings = contracts.check_transition(sizes=(100_000,))
+    assert time.monotonic() - t0 < 10.0
+    assert findings == []
+
+
+def test_contract_checker_catches_wide_dtype_and_drift():
+    import jax
+    import numpy as np
+
+    from corrosion_tpu.analysis import contracts
+
+    i32 = jax.ShapeDtypeStruct((4,), np.dtype("int32"))
+    i64 = jax.ShapeDtypeStruct((4,), np.dtype("int64"))
+    wide = contracts.wide_dtype_findings(128, [i32, i64])
+    assert [f.rule for f in wide] == ["GL302"]
+
+    drift = contracts.stability_findings(128, [i32, i32], [i32, i64])
+    assert [f.rule for f in drift] == ["GL301"]
+    arity = contracts.stability_findings(128, [i32, i32], [i32])
+    assert [f.rule for f in arity] == ["GL301"]
+
+
+# -- the shipped repo lints clean --------------------------------------------
+
+def test_repo_lints_clean():
+    findings = lint_repo()
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings
+    )
+    assert exit_code(findings) == 0
+
+
+def test_every_suppression_in_repo_carries_reason():
+    for dirpath, _d, files in os.walk(os.path.join(REPO, "corrosion_tpu")):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            if "graftlint: disable" not in src:
+                continue
+            sups, meta = scan_suppressions(path, src)
+            assert meta == [], f"{path}: {[m.message for m in meta]}"
+            assert all(s.reason for s in sups), path
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def cli_lint(extra, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "corrosion_tpu.cli", "lint", *extra],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_lint_exits_zero_on_repo():
+    proc = cli_lint([])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graftlint: clean" in proc.stdout
+
+
+def test_cli_lint_json_mode(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import asyncio\n"
+        "class S:\n"
+        "    async def go(self):\n"
+        "        asyncio.create_task(self.work())\n"
+    )
+    proc = cli_lint(["--json", str(bad)])
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout)
+    assert out["counts"]["error"] == 1
+    assert out["findings"][0]["rule"] == "GL204"
+    assert out["findings"][0]["line"] == 4
+
+
+def test_cli_lint_fail_on_warning(tmp_path):
+    warn = tmp_path / "warn.py"
+    warn.write_text(
+        "class S:\n"
+        "    async def go(self, fs):\n"
+        "        return await fs.recv()\n"
+    )
+    assert cli_lint([str(warn)]).returncode == 0  # warning only
+    assert cli_lint(["--fail-on=warning", str(warn)]).returncode == 1
+
+
+def test_render_json_lists_rule_catalogue():
+    out = json.loads(render_json([]))
+    assert set(RULES) <= set(out["rules"])
+
+
+# -- agent --self-check metric -----------------------------------------------
+
+def test_self_check_emits_lint_findings_total():
+    from corrosion_tpu.cli import _self_check
+    from corrosion_tpu.utils.metrics import registry, render_prometheus
+
+    registry.reset()
+    _self_check()
+    rendered = render_prometheus()
+    assert 'lint_findings_total{severity="error"} 0' in rendered
+    assert 'lint_findings_total{severity="warning"} 0' in rendered

@@ -4,7 +4,6 @@ SyncTraceContextV1 in peer.rs:937-940/1317-1319)."""
 
 import asyncio
 
-import pytest
 from aiohttp import ClientSession
 
 from corrosion_tpu.client import CorrosionApiClient

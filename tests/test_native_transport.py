@@ -169,7 +169,7 @@ def test_cluster_on_native_transport():
     """3 nodes gossiping over the native transport converge end-to-end
     (SWIM datagrams + broadcast uni frames + sync bi sessions all ride
     the C++ core)."""
-    from tests.test_cluster import SCHEMA, boot_node, wait_for
+    from tests.test_cluster import boot_node, wait_for
     from corrosion_tpu.transport.native import NativeTransport as NT
 
     async def main():

@@ -8,7 +8,6 @@ tests drive the server with a minimal hand-rolled v3 protocol client.
 import asyncio
 import struct
 
-import pytest
 
 from corrosion_tpu.agent import Agent, AgentConfig
 from corrosion_tpu.pg import PgServer, split_statements, translate_sql

@@ -12,7 +12,10 @@ bounded at 30 s (the headline convergence baseline, tests.rs:265-267 and
   statements) — tests.rs:265-267
 
 The 45-node "stresser" tier is #[ignore]d upstream and correspondingly
-marked slow here.
+marked slow here.  The 30-node tier is also marked slow: with every node
+sharing one CPU event loop the spray phase alone runs for many minutes,
+which blows the fast-tier budget (the chill tier keeps end-to-end
+convergence covered there).
 """
 
 import asyncio
@@ -20,7 +23,7 @@ import random
 import time
 
 import pytest
-from aiohttp import ClientSession
+from aiohttp import ClientSession, ClientTimeout
 
 from corrosion_tpu.harness import DevCluster, Topology
 
@@ -72,7 +75,8 @@ async def spray_and_converge(
         # nodes (ref: tests.rs:341-400 — 4*input_count changesets)
         expected_rows = input_count * 4
         t_spray = time.monotonic()
-        async with ClientSession() as http:
+        # per-request bound: a starved node must fail the test, not hang it
+        async with ClientSession(timeout=ClientTimeout(total=60)) as http:
             for i in range(input_count):
                 node = nodes[rng.randrange(n_nodes)]
                 stmts = [
@@ -119,6 +123,7 @@ def test_chill():
     asyncio.run(spray_and_converge(2, 1, 1))
 
 
+@pytest.mark.slow
 def test_stress_30_nodes():
     """ref: stress_test (30, 10, 200 inputs -> 800 changesets),
     tests.rs:265-267 — the headline convergence baseline."""

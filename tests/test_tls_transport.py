@@ -3,14 +3,11 @@ the reference transport, api/peer.rs:133-324, and test_mutual_tls,
 peer.rs:1773-1881 — a full handshake with generated certs)."""
 
 import asyncio
-import ssl
 
 import pytest
-from aiohttp import ClientSession
 
 from corrosion_tpu.agent.node import Node
 from corrosion_tpu.client import CorrosionApiClient
-from corrosion_tpu.harness import free_port
 from corrosion_tpu.types.config import Config, GossipTlsConfig
 from corrosion_tpu.types.schema import apply_schema
 from corrosion_tpu.utils import tls as tlsmod

@@ -4,7 +4,6 @@ watch loop with atomic replace and subscription-driven re-render)."""
 
 import asyncio
 import json
-import os
 
 import pytest
 
@@ -13,7 +12,7 @@ from corrosion_tpu.api.http import Api
 from corrosion_tpu.client import CorrosionApiClient
 from corrosion_tpu.pubsub import SubsManager
 from corrosion_tpu.pubsub import matcher as matcher_mod
-from corrosion_tpu.tpl import Engine, QueryResponse, TemplateError, compile_template
+from corrosion_tpu.tpl import Engine, TemplateError, compile_template
 from corrosion_tpu.tpl.watch import TemplateWatcher, parse_template_spec
 
 SCHEMA = (

@@ -5,12 +5,16 @@ CRDT convergence in < 60 s wall-clock, with gossip-round counts matching
 the CPU reference within ±2% (matched exactly by the shared RNG design —
 asserted here at reduced scale, and by tests/test_sim.py on all configs).
 
-Prints one JSON line per headline config (3, 5, then the headline 4 LAST
-so a last-line parser records the headline):
-  {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
+Prints one JSON line per BASELINE config (1, 2, 3, 5, then the headline
+4 LAST so a last-line parser records the headline):
+  {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...,
+   "cache": "cold"|"warm"}
 value = total wall-clock (compile + execute) of that BASELINE config run
 to convergence on the attached accelerator.
 vs_baseline = 60 / value (>1 ⇒ beats the north-star bound).
+cache = whether the run compiled fresh ("cold": it added entries to the
+persistent compilation cache) or was served from it ("warm") — so a
+dashboard never mistakes a cache-hit run's `value` for a cold headline.
 
 Extra diagnostics go to stderr; `--config N` restricts to a single
 BASELINE config, `--scale F` scales node count (dev/debug).
@@ -28,13 +32,33 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def run_config(n: int, seed: int, scale: float, dev) -> dict:
+def _cache_entries(cache_dir: str) -> int:
+    """Number of entries in the persistent compilation cache (0 when the
+    directory doesn't exist yet)."""
+    import os
+
+    try:
+        return len(os.listdir(cache_dir))
+    except OSError:
+        return 0
+
+
+def run_config(n: int, seed: int, scale: float, dev, cache_dir: str) -> dict:
     from corrosion_tpu.sim import cluster, crdt, model, reference
 
     p = model.CONFIGS[n](seed=seed)
     if scale != 1.0:
         p = p.with_(n_nodes=max(8, int(p.n_nodes * scale)))
     log(f"config {n}: {p}")
+
+    # cold/warm detection: if this invocation ADDS entries to the
+    # persistent compilation cache, XLA compiled the config fresh
+    # ("cold" — value includes real compile time); otherwise everything
+    # was served from the cache ("warm" — compile_s is just cache-load).
+    # Counted from BEFORE the fidelity check: at small configs the
+    # reduced-scale program IS the headline program, and its compile
+    # must count toward this invocation's cache state.
+    entries_before = _cache_entries(cache_dir)
 
     # fidelity spot-check vs the CPU reference at reduced scale (the full
     # fidelity matrix runs in tests/test_sim.py)
@@ -55,9 +79,13 @@ def run_config(n: int, seed: int, scale: float, dev) -> dict:
     )
 
     res = cluster.run(p, return_state=True)
+    cache_state = (
+        "cold" if _cache_entries(cache_dir) > entries_before else "warm"
+    )
     log(
         f"run: converged={res.converged} rounds={res.rounds} "
-        f"compile={res.compile_s:.2f}s execute={res.wall_s:.2f}s"
+        f"compile={res.compile_s:.2f}s execute={res.wall_s:.2f}s "
+        f"cache={cache_state}"
     )
 
     # CRDT merge on the final state: every node must agree on every LWW
@@ -97,6 +125,7 @@ def run_config(n: int, seed: int, scale: float, dev) -> dict:
         "compile_s": round(res.compile_s, 3),
         "warm_s": round(warm_total, 3),
         "warm_execute_s": round(warm.wall_s, 3),
+        "cache": cache_state,
         "device": dev.platform,
     }
 
@@ -107,7 +136,8 @@ def main() -> None:
         "--config",
         type=int,
         default=None,
-        help="run a single BASELINE config (default: 3, 5, then headline 4)",
+        help="run a single BASELINE config (default: 1, 2, 3, 5, then "
+        "headline 4)",
     )
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--seed", type=int, default=0)
@@ -119,22 +149,23 @@ def main() -> None:
     import jax
 
     # persistent compilation cache: repeat runs measure marginal cost
-    # honestly instead of re-paying XLA compilation every time (compile_s
-    # in the output shows which case this run was)
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    # honestly instead of re-paying XLA compilation every time (the
+    # "cache" field in the output shows which case each run was)
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
     )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
 
-    # headline config 4 goes LAST so last-line JSON parsers record it
-    configs = [args.config] if args.config is not None else [3, 5, 4]
+    # the full BASELINE config set; headline config 4 goes LAST so
+    # last-line JSON parsers record it
+    configs = [args.config] if args.config is not None else [1, 2, 3, 5, 4]
     for n in configs:
-        out = run_config(n, args.seed, args.scale, dev)
+        out = run_config(n, args.seed, args.scale, dev, cache_dir)
         print(json.dumps(out), flush=True)
     log(f"total harness wall (incl. imports): {time.perf_counter()-t_all:.2f}s")
 

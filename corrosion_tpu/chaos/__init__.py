@@ -1,0 +1,51 @@
+"""Deterministic fault injection: one schedule, two executors.
+
+``chaos`` turns the repo's hand-rolled per-test partitions and churn
+scalars into a first-class subsystem (doc/chaos.md):
+
+- :mod:`.schedule` — the typed fault-schedule model.  A schedule is a
+  pure function of ``(seed, GenParams)`` via the counter-based RNG in
+  :mod:`corrosion_tpu.sim.rng` (TAG_CHAOS); canonical-JSON serializable
+  with a sha256 ``schedule_hash``.
+- :mod:`.lower` — compiles a schedule into dense per-round mask tensors
+  (liveness, wipe, restart, partition, per-link drop ppm) that BOTH
+  executors consume.
+- :mod:`.runtime` — applies the lowered schedule to a live
+  :class:`~corrosion_tpu.harness.DevCluster` at round barriers through
+  the harness's partition / kill / fault-hook machinery, exporting
+  ``corro.chaos.injected.total{kind}`` / ``corro.chaos.schedule.hash``.
+- :mod:`.compare` — paired-run comparator: replays one schedule on the
+  real harness cluster and on the scalar reference simulator with
+  paired draws (:mod:`.pairing`) and reports convergence-round deltas —
+  the fidelity matrix extended into adversarial regimes.
+
+The sim side enters through ``sim.cluster.run(p, chaos=lower(...))``,
+which subsumes the ad-hoc ``churn_ppm`` / ``partition_frac_ppm``
+scalars as degenerate cases (:func:`.schedule.from_sim_params` is the
+bridge, asserted bit-identical in tests/test_chaos.py).
+"""
+
+from .compare import CompareResult, compare, params_for
+from .lower import LoweredChaos, lower
+from .runtime import ChaosInjector
+from .schedule import (
+    ChaosEvent,
+    ChaosSchedule,
+    GenParams,
+    from_sim_params,
+    generate,
+)
+
+__all__ = [
+    "ChaosEvent",
+    "ChaosInjector",
+    "ChaosSchedule",
+    "CompareResult",
+    "GenParams",
+    "LoweredChaos",
+    "compare",
+    "from_sim_params",
+    "generate",
+    "lower",
+    "params_for",
+]

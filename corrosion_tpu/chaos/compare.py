@@ -1,0 +1,366 @@
+"""Paired-run comparator: one chaos schedule, both executors, one
+verdict.
+
+``compare(schedule)`` replays the SAME fault schedule on the real
+harness (DevCluster + :class:`~corrosion_tpu.chaos.runtime.ChaosInjector`)
+and on the scalar reference simulator (``run_reference(p,
+chaos=lower(schedule))``), with every shared random decision paired
+through :mod:`corrosion_tpu.chaos.pairing` — write origins, fanout
+targets, sync peers, partition sides and death schedules all replay the
+sim's counter-based hash draws, and link-drop verdicts share one
+``TAG_CHAOS_DROP`` draw per (round, src, dst).  What remains unpaired
+is exactly the protocol dynamics under test, so the gossip-rounds gap
+between the two backends is a meaningful fidelity number at a single
+schedule (the BASELINE experiments need 24-trial means for the same
+±2% bar; the chaos acceptance test pins a seed where the paired runs
+agree exactly).
+
+The harness leg also produces two digests for the determinism
+contract (ISSUE satellite 3): a delivery-ledger digest (per-round
+expected/handled datagram and uni-frame counters) and a membership
+digest (per-round, per-node sorted up-member sets).  Two runs of the
+same schedule produce byte-identical digests; a different seed produces
+a different schedule hash and (in general) different digests.
+
+Schedules must be harness-runnable to compare: every crash needs a
+real down window (``down_rounds >= 1`` — a wipe-only crash has no
+crash-stop realization) and a revival inside the horizon (a node down
+forever can never re-register its writes, so convergence is
+unreachable by construction).  Delay and clock-skew events are
+runtime-only and rejected by the sim leg (``require_sim_lowerable``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..sim.model import SimParams
+from .lower import LoweredChaos, lower
+from .pairing import (
+    PROBE_TIMEOUT,
+    SUSPICION_ROUNDS,
+    arm_node,
+    converged,
+    install_fanout_pairing,
+    paired_sync_draw,
+    sim_origins,
+    star_topology,
+)
+from .runtime import ChaosInjector
+from .schedule import CRASH, RESTART, ChaosSchedule
+
+__all__ = [
+    "CompareResult",
+    "HarnessRun",
+    "compare",
+    "harness_run",
+    "params_for",
+    "sim_rounds",
+]
+
+SCHEMA = (
+    'CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, '
+    'text TEXT NOT NULL DEFAULT "") WITHOUT ROWID;'
+)
+
+_ids = itertools.count(1)
+
+
+@dataclass(frozen=True)
+class HarnessRun:
+    """One harness replay: rounds to convergence (None = did not
+    converge within the horizon) plus the determinism digests."""
+
+    rounds: Optional[int]
+    ledger_digest: str
+    membership_digest: str
+
+
+@dataclass(frozen=True)
+class CompareResult:
+    schedule_hash: str
+    harness_rounds: Optional[int]
+    sim_rounds: Optional[int]
+    ledger_digest: str
+    membership_digest: str
+
+    @property
+    def gap(self) -> Optional[float]:
+        """|harness − sim| / sim, or None when either leg failed to
+        converge."""
+        if not self.harness_rounds or not self.sim_rounds:
+            return None
+        return abs(self.harness_rounds - self.sim_rounds) / self.sim_rounds
+
+    def to_dict(self) -> dict:
+        return {
+            "schedule_hash": self.schedule_hash,
+            "harness_rounds": self.harness_rounds,
+            "sim_rounds": self.sim_rounds,
+            "gap": self.gap,
+            "ledger_digest": self.ledger_digest,
+            "membership_digest": self.membership_digest,
+        }
+
+
+def params_for(
+    schedule: ChaosSchedule,
+    *,
+    n_changes: int = 8,
+    fanout: int = 3,
+    max_transmissions: int = 2,
+    sync_interval: int = 3,
+    max_rounds: Optional[int] = None,
+    swim_per_node_views: bool = False,
+) -> SimParams:
+    """The SimParams both legs share for this schedule.  The chaos
+    scalars stay ZERO — every fault comes from the schedule — and the
+    seed is the schedule's (the link-drop draws and the paired
+    origin/fanout/sync draws must key off the same value)."""
+    return SimParams(
+        n_nodes=schedule.n_nodes,
+        n_changes=n_changes,
+        fanout=fanout,
+        max_transmissions=max_transmissions,
+        sync_interval=sync_interval,
+        write_rounds=1,
+        max_rounds=max(schedule.n_rounds, max_rounds or 0),
+        swim=True,
+        swim_suspicion=True,
+        swim_suspicion_rounds=SUSPICION_ROUNDS,
+        swim_per_node_views=swim_per_node_views,
+        fanout_per_change=True,
+        seed=schedule.seed,
+    )
+
+
+def check_harness_runnable(schedule: ChaosSchedule) -> None:
+    """Reject schedules whose faults have no convergent crash-stop
+    realization (module doc).  Raises ``ValueError``."""
+    explicit_restarts: Dict[int, List[int]] = {}
+    for e in schedule.sorted_events():
+        if e.kind == RESTART:
+            for n in e.nodes:
+                explicit_restarts.setdefault(n, []).append(e.round)
+    for e in schedule.sorted_events():
+        if e.kind != CRASH:
+            continue
+        if e.down_rounds == 0:
+            raise ValueError(
+                f"crash at round {e.round} has down_rounds=0: a "
+                "wipe-only crash is sim-only (no crash-stop realization)"
+            )
+        for n in e.nodes:
+            if e.down_rounds > 0:
+                revive = e.round + e.down_rounds + 1
+            else:
+                later = [r for r in explicit_restarts.get(n, ()) if r > e.round]
+                if not later:
+                    raise ValueError(
+                        f"crash at round {e.round} on node {n} with "
+                        "down_rounds=-1 and no later restart event"
+                    )
+                revive = min(later)
+            if revive >= schedule.n_rounds:
+                raise ValueError(
+                    f"node {n} crashed at round {e.round} revives at "
+                    f"{revive}, beyond the {schedule.n_rounds}-round horizon"
+                )
+
+
+async def harness_run(
+    schedule: ChaosSchedule,
+    p: Optional[SimParams] = None,
+    lowered: Optional[LoweredChaos] = None,
+) -> HarnessRun:
+    """Replay ``schedule`` on a real DevCluster with fully paired
+    draws; returns rounds-to-convergence plus determinism digests.
+
+    The choreography is the merged churn + partition fidelity trial
+    (tests/test_sim_vs_harness.py) driven by the lowered arrays instead
+    of ad-hoc per-test fault parameters: the injector boots due
+    replacements before each round's SWIM phase and crash-stops victims
+    after the round's deliveries — exactly the sim's event timing."""
+    # deferred: the comparator is importable without a bootable runtime
+    from ..agent.agent import make_broadcastable_changes
+    from ..harness import DevCluster
+
+    check_harness_runnable(schedule)
+    if p is None:
+        p = params_for(schedule)
+    assert p.seed == schedule.seed, "paired draws need p.seed == schedule.seed"
+    assert p.n_nodes == schedule.n_nodes
+    if lowered is None:
+        lowered = lower(schedule, horizon=p.max_rounds)
+
+    topo, names = star_topology(p.n_nodes)
+    gossip_tweaks = {
+        "max_transmissions": p.max_transmissions,
+        "swim_impl": "python",
+        "probe_period": 1.0,
+        "probe_timeout": PROBE_TIMEOUT,
+        # suspect at ~+0.7 in its round; DOWN on the round boundary
+        # SUSPICION_ROUNDS later (harness/swim_phase)
+        "suspicion_timeout": SUSPICION_ROUNDS - 0.7,
+        # periodic-gossip feeds would consume the seeded swim rng and
+        # re-roll the validated draw streams
+        "feed_every_acks": 0,
+    }
+    if lowered.any_partition():
+        # one announce-to-down per round: the real heal mechanism the
+        # sim abstracts as swim_rejoin_rounds
+        gossip_tweaks["announce_down_period"] = 1.0
+    cluster = DevCluster(
+        topo,
+        schema=SCHEMA,
+        seeded_actors=True,
+        config_tweaks={
+            "perf": {
+                "manual_pacing": True,
+                "manual_swim": True,
+                "flush_interval": 0.01,
+            },
+            "gossip": gossip_tweaks,
+        },
+    )
+    await cluster.start()
+    nodes = {name: cluster[name] for name in names}
+    cluster.seed_full_membership()
+    for i, name in enumerate(names):
+        arm_node(nodes[name], p.seed, i)
+
+    rng = random.Random(9_000_000 + p.seed)  # harness-local draws only
+    writes: Dict[str, list] = {name: [] for name in names}
+    expected_heads: dict = {}
+    key_to_k: dict = {}  # (actor, versions) -> sim changeset index
+    ledger = hashlib.sha256()
+    membership = hashlib.sha256()
+    injector = ChaosInjector(cluster, lowered, names)
+    injector.install()
+
+    # membership is recorded by node NAME: ports are ephemeral per boot,
+    # and a digest over them would differ between byte-identical runs
+    name_of_port = {cluster._ports[nm]: nm for nm in names}
+
+    def record_round(r: int) -> None:
+        ledger.update(
+            (
+                f"{r}:{cluster._dgram_exp}:{cluster._dgram_got}:"
+                f"{cluster._uni_exp}:{cluster._uni_got}\n"
+            ).encode()
+        )
+        for name in names:
+            node = cluster.nodes.get(name)
+            if node is None:
+                membership.update(f"{r}:{name}:down\n".encode())
+            else:
+                ups = sorted(
+                    name_of_port[m.addr[1]]
+                    for m in node.members.up_members()
+                )
+                membership.update(f"{r}:{name}:{ups}\n".encode())
+
+    async def on_restart(r: int, n: int, node) -> None:
+        name = names[n]
+        nodes[name] = node
+        arm_node(node, p.seed, n, next_probe_at=float(r))
+        # replacement-only seeding: peers revive THIS node via its
+        # announce; their DOWN knowledge of other dead members survives
+        cluster.seed_node_membership(node, now=float(r))
+        install_fanout_pairing(cluster, names, p, key_to_k, node, n)
+        await cluster.announce_all(node)
+        # replacement re-registers its own writes (fresh budgets; a
+        # fresh store reallocates the same version numbers, so the
+        # (actor, versions) -> k pairing keys still match)
+        for stmts in writes[name]:
+            out = await make_broadcastable_changes(node.agent, stmts)
+            await node.broadcast.enqueue(out.changesets)
+
+    rounds: Optional[int] = None
+    try:
+        # paired injection: the sim's origins for this seed, all round 0
+        for k, origin in enumerate(sim_origins(p)):
+            name = names[origin]
+            node = nodes[name]
+            stmts = [
+                (
+                    "INSERT INTO tests (id,text) VALUES (?,?)",
+                    (next(_ids), "x" * 40),
+                )
+            ]
+            writes[name].append(stmts)
+            out = await make_broadcastable_changes(node.agent, stmts)
+            for cs in out.changesets:
+                key_to_k[(bytes(cs.actor_id), cs.changeset.versions)] = k
+            await node.broadcast.enqueue(out.changesets)
+            aid = node.agent.actor_id
+            expected_heads[aid] = expected_heads.get(aid, 0) + 1
+        for i, name in enumerate(names):
+            install_fanout_pairing(
+                cluster, names, p, key_to_k, nodes[name], i
+            )
+
+        for r in range(p.max_rounds):
+            await injector.begin_round(r, on_restart=on_restart)
+            await cluster.step_round(
+                r, sync_interval=p.sync_interval, rng=rng, swim=True,
+                sync_draw=paired_sync_draw(p),
+                sync_attempts=p.swim_probe_attempts,
+            )
+            record_round(r)
+            await injector.end_round(r)
+            if not injector.outstanding_down and converged(
+                list(cluster.nodes.values()), expected_heads
+            ):
+                rounds = r + 1
+                break
+    finally:
+        injector.uninstall()
+        await cluster.stop()
+    return HarnessRun(
+        rounds=rounds,
+        ledger_digest=ledger.hexdigest(),
+        membership_digest=membership.hexdigest(),
+    )
+
+
+def sim_rounds(
+    schedule: ChaosSchedule,
+    p: Optional[SimParams] = None,
+    lowered: Optional[LoweredChaos] = None,
+) -> Optional[int]:
+    """The scalar reference's rounds-to-convergence under ``schedule``
+    (None = did not converge within the horizon).  The reference IS the
+    sim for fidelity purposes — tests/test_sim.py proves it bit-
+    identical to the JAX program — and needs no accelerator."""
+    from ..sim.reference import run_reference
+
+    if p is None:
+        p = params_for(schedule)
+    if lowered is None:
+        lowered = lower(schedule, horizon=p.max_rounds)
+    res = run_reference(p, chaos=lowered)
+    return res.rounds if res.converged else None
+
+
+async def compare(
+    schedule: ChaosSchedule, p: Optional[SimParams] = None
+) -> CompareResult:
+    """Run both legs and report rounds + gap + determinism digests."""
+    if p is None:
+        p = params_for(schedule)
+    lowered = lower(schedule, horizon=p.max_rounds)
+    lowered.require_sim_lowerable()
+    hr = await harness_run(schedule, p, lowered)
+    sr = sim_rounds(schedule, p, lowered)
+    return CompareResult(
+        schedule_hash=schedule.schedule_hash(),
+        harness_rounds=hr.rounds,
+        sim_rounds=sr,
+        ledger_digest=hr.ledger_digest,
+        membership_digest=hr.membership_digest,
+    )

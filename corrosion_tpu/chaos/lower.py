@@ -1,0 +1,249 @@
+"""Compile a :class:`~corrosion_tpu.chaos.schedule.ChaosSchedule` into
+dense per-round mask tensors.
+
+The lowered form is the ONE source of truth both executors consume: the
+sim threads these arrays through its ``lax.scan`` / ``lax.while_loop``
+carry as gather-by-round constants (`sim/cluster.py` indexes
+``dead[r]``, ``die[r]``, ``restart[r]``, ``part_active[r]``,
+``drop_ppm[r]``), and the harness injector / paired comparator walk the
+same arrays at round barriers (kill after round r where ``die[r, n]``,
+boot the replacement before round r where ``restart[r, n]``).  Lowering
+once and sharing the result is what makes the two backends agree on the
+fault trajectory by construction instead of by careful duplication.
+
+Liveness walk (bit-exact against the simulator's churn semantics,
+``cluster.py alive_at``): a crash at round x with ``down_rounds = D``
+wipes the node at the END of x (it participates in x), keeps it
+unresponsive for rounds ``x+1 .. x+D``, and boots its replacement at
+the START of ``x+D+1`` — where the replacement's restart flag fires
+only if the node was dead for at least one full round (D = 0 is a
+wipe-only crash: ``alive_at`` never dips, so the sim's
+``restarted = alive & ~alive_at(r-1)`` never fires, and neither does
+ours).  A crash landing on an already-down node overwrites its revive
+round (for the constant-D schedules :func:`from_sim_params` emits this
+equals the sim's union-of-windows rule, because the later window always
+ends later).
+
+All arrays are padded to ``horizon`` rounds (≥ the schedule's
+``n_rounds``; the sim requires horizon ≥ ``p.max_rounds`` so that
+in-bounds gathers never rely on XLA's clamp-on-OOB behavior).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .schedule import (
+    CLOCK_SKEW,
+    CRASH,
+    HEAL,
+    LINK,
+    PARTITION,
+    RESTART,
+    ChaosSchedule,
+)
+
+__all__ = ["LoweredChaos", "lower"]
+
+_NEVER = 1 << 30  # revive round for down_rounds = -1 (explicit restart only)
+
+
+@dataclass(frozen=True)
+class LoweredChaos:
+    """Dense per-round fault tensors for an ``n_nodes`` cluster over
+    ``horizon`` rounds.  ``schedule`` keeps the source object (its
+    ``seed`` keys the per-link drop/duplicate draws at execution time;
+    its hash feeds the ``chaos_schedule_hash`` gauge)."""
+
+    schedule: ChaosSchedule
+    horizon: int
+    part_side: np.ndarray  # int8[N] static side labels (0/1)
+    part_active: np.ndarray  # bool[R] partition in force during round r
+    dead: np.ndarray  # bool[R, N] node unresponsive during round r
+    die: np.ndarray  # bool[R, N] node wiped at END of round r
+    restart: np.ndarray  # bool[R, N] replacement boots at START of round r
+    # link faults, max-merged over overlapping windows; None when the
+    # schedule has none of that fault (so the sim compiles nothing)
+    drop_ppm: Optional[np.ndarray]  # int32[R, N, N] src-major
+    dup_ppm: Optional[np.ndarray]  # int32[R, N, N] runtime injector only
+    delay_rounds: Optional[np.ndarray]  # int32[R, N, N] runtime only
+    skew: Optional[np.ndarray]  # int32[R, N] SWIM clock skew, runtime only
+
+    @property
+    def n_nodes(self) -> int:
+        return self.schedule.n_nodes
+
+    def any_die(self) -> bool:
+        return bool(self.die.any())
+
+    def any_partition(self) -> bool:
+        return bool(self.part_active.any())
+
+    def require_sim_lowerable(self) -> None:
+        """The round-synchronous simulator has no wall clock and no
+        reorder buffer: delay and clock-skew events only exist for the
+        runtime injector.  (Duplicates ARE sim-lowerable — coverage
+        masks OR-absorb them into a no-op — so they pass silently.)"""
+        if self.delay_rounds is not None:
+            raise ValueError(
+                "schedule carries link delay events; the round-synchronous "
+                "sim cannot model intra-round reordering (runtime only)"
+            )
+        if self.skew is not None:
+            raise ValueError(
+                "schedule carries clock_skew events; the sim has no SWIM "
+                "wall clock to skew (runtime only)"
+            )
+
+    def summarize(self) -> Dict[str, int]:
+        """Event-count summary for CLI output / metrics."""
+        out = {
+            "partition_rounds": int(self.part_active.sum()),
+            "crashes": int(self.die.sum()),
+            "restarts": int(self.restart.sum()),
+        }
+        if self.drop_ppm is not None:
+            out["drop_link_rounds"] = int((self.drop_ppm > 0).sum())
+        if self.dup_ppm is not None:
+            out["dup_link_rounds"] = int((self.dup_ppm > 0).sum())
+        if self.delay_rounds is not None:
+            out["delay_link_rounds"] = int((self.delay_rounds > 0).sum())
+        if self.skew is not None:
+            out["skew_node_rounds"] = int((self.skew != 0).sum())
+        return out
+
+
+def lower(sched: ChaosSchedule, horizon: Optional[int] = None) -> LoweredChaos:
+    """Validate ``sched`` and compile it to :class:`LoweredChaos` over
+    ``max(sched.n_rounds, horizon or 0)`` rounds."""
+    sched.validate()
+    N = sched.n_nodes
+    R = max(sched.n_rounds, horizon or 0)
+
+    by_round: Dict[int, List] = defaultdict(list)
+    for e in sched.sorted_events():
+        by_round[e.round].append(e)
+
+    part_side = np.zeros(N, dtype=np.int8)
+    part_active = np.zeros(R, dtype=bool)
+    dead = np.zeros((R, N), dtype=bool)
+    die = np.zeros((R, N), dtype=bool)
+    restart = np.zeros((R, N), dtype=bool)
+    drop: Optional[np.ndarray] = None
+    dup: Optional[np.ndarray] = None
+    delay: Optional[np.ndarray] = None
+    skew: Optional[np.ndarray] = None
+
+    def _link_plane(existing: Optional[np.ndarray]) -> np.ndarray:
+        return (
+            existing
+            if existing is not None
+            else np.zeros((R, N, N), dtype=np.int32)
+        )
+
+    part_set = None  # the one static side-1 node set (sim needs it fixed)
+    part_on = False
+    revive_at = np.full(N, -1, dtype=np.int64)  # <0 = alive
+
+    for r in range(R):
+        # START of round r: boot replacements whose window just closed
+        # (restart flag only after >= 1 full dead round; see module doc)
+        for n in range(N):
+            if revive_at[n] == r:
+                revive_at[n] = -1
+                if r > 0 and dead[r - 1, n]:
+                    restart[r, n] = True
+        for e in by_round.get(r, ()):
+            if e.kind == RESTART:
+                for n in e.nodes:
+                    revive_at[n] = -1
+                    if r > 0 and dead[r - 1, n]:
+                        restart[r, n] = True
+
+        dead[r] = revive_at >= 0
+        if part_on:
+            part_active[r] = True
+
+        for e in by_round.get(r, ()):
+            if e.kind == PARTITION:
+                side = frozenset(e.nodes)
+                if part_set is None:
+                    part_set = side
+                    for n in side:
+                        part_side[n] = 1
+                elif side != part_set:
+                    raise ValueError(
+                        "multiple partition events with different node "
+                        "sets: the side assignment must be static "
+                        f"(round {e.round})"
+                    )
+                part_on = True
+                part_active[r] = True
+            elif e.kind == HEAL:
+                part_on = False
+                part_active[r] = False
+            elif e.kind == CRASH:
+                # END of round r: wipe now, dead from r+1
+                for n in e.nodes:
+                    die[r, n] = True
+                    if e.down_rounds != 0:
+                        revive_at[n] = (
+                            _NEVER
+                            if e.down_rounds < 0
+                            else r + e.down_rounds + 1
+                        )
+            elif e.kind == LINK:
+                until = min(e.until_round, R)
+                srcs = list(e.src) if e.src else list(range(N))
+                dsts = list(e.dst) if e.dst else list(range(N))
+                if e.drop_ppm:
+                    drop = _link_plane(drop)
+                    _apply_link(drop, r, until, srcs, dsts, e.drop_ppm)
+                if e.duplicate_ppm:
+                    dup = _link_plane(dup)
+                    _apply_link(dup, r, until, srcs, dsts, e.duplicate_ppm)
+                if e.delay_rounds:
+                    delay = _link_plane(delay)
+                    _apply_link(delay, r, until, srcs, dsts, e.delay_rounds)
+            elif e.kind == CLOCK_SKEW:
+                if skew is None:
+                    skew = np.zeros((R, N), dtype=np.int32)
+                for n in e.nodes:
+                    skew[r:, n] += e.skew_rounds
+
+    if drop is not None or dup is not None or delay is not None:
+        for plane in (drop, dup, delay):
+            if plane is not None:
+                # self-links don't exist; keep the diagonal inert
+                for n in range(N):
+                    plane[:, n, n] = 0
+
+    return LoweredChaos(
+        schedule=sched,
+        horizon=R,
+        part_side=part_side,
+        part_active=part_active,
+        dead=dead,
+        die=die,
+        restart=restart,
+        drop_ppm=drop,
+        dup_ppm=dup,
+        delay_rounds=delay,
+        skew=skew,
+    )
+
+
+def _apply_link(
+    plane: np.ndarray,
+    r_from: int,
+    r_until: int,
+    srcs: List[int],
+    dsts: List[int],
+    value: int,
+) -> None:
+    sub = plane[r_from:r_until][:, srcs][:, :, dsts]
+    plane[np.ix_(range(r_from, r_until), srcs, dsts)] = np.maximum(sub, value)

@@ -1,0 +1,174 @@
+"""Paired-randomness machinery shared by the fidelity experiments and
+the chaos comparator.
+
+Round-count variance in fault regimes is dominated by draw luck — which
+nodes die, where writes originate, which sync peer a fresh replacement
+pulls from — not by the dissemination dynamics under test.  Every helper
+here replays the SIM's exact counter-based hash draws (sim/rng.py)
+inside the real harness, so paired sim/harness runs differ only by the
+protocol dynamics: without pairing, a ±2% assertion on mean round counts
+would need hundreds of trials (tests/test_sim_vs_harness.py, where this
+machinery was developed; chaos/compare.py drives it from a fault
+schedule instead of ad-hoc per-test parameters).
+"""
+
+from __future__ import annotations
+
+import random
+
+from ..harness import Topology
+from ..sim.model import SimParams
+from ..sim.reference import _bcast_target as _ref_bcast_target
+from ..sim.rng import (
+    TAG_CHURN,
+    TAG_ORIGIN,
+    TAG_PART,
+    TAG_SYNC,
+    py_below,
+)
+from .. import wire as _wire
+
+__all__ = [
+    "PROBE_TIMEOUT",
+    "SUSPICION_ROUNDS",
+    "arm_node",
+    "converged",
+    "install_fanout_pairing",
+    "paired_sync_draw",
+    "sim_death_schedule",
+    "sim_origins",
+    "sim_partition_sides",
+    "star_topology",
+]
+
+# round-paced SWIM timer mapping (harness/swim_phase): suspect at ~+0.7
+# within a round, DOWN on the round boundary SUSPICION_ROUNDS later
+SUSPICION_ROUNDS = 3
+PROBE_TIMEOUT = 0.3
+
+
+def star_topology(n: int):
+    """A star over n named nodes — bootstrap reachability in one hop;
+    full SWIM membership makes the gossip topology complete regardless."""
+    topo = Topology()
+    names = [f"n{i:02d}" for i in range(n)]
+    topo.edges[names[0]] = []
+    for name in names[1:]:
+        topo.add_edge(name, names[0])
+    return topo, names
+
+
+def converged(nodes, expected_heads) -> bool:
+    """The stress-test convergence bar: nothing needed anywhere AND every
+    node's per-actor heads equal the global write counts
+    (ref: tests.rs:464-476 all-rows + need_len()==0)."""
+    for node in nodes:
+        st = node.agent.generate_sync()
+        if st.need_len() != 0 or st.heads != expected_heads:
+            return False
+    return True
+
+
+def paired_sync_draw(p: SimParams):
+    """The sim's exact TAG_SYNC peer draw (reference._sync_peer), handed
+    to step_round so harness and sim sync with the SAME peers per
+    (round, node) — pairing away the draw luck that dominates the means
+    (e.g. whether a fresh replacement pulls from another empty
+    replacement or from a converged node)."""
+
+    def draw(r: int, me: int, a: int) -> int:
+        suffix = () if a == 0 else (a,)
+        q = py_below(p.n_nodes - 1, p.seed, TAG_SYNC, r, me, *suffix)
+        return q + 1 if q >= me else q
+
+    return draw
+
+
+def install_fanout_pairing(cluster, names, p: SimParams, key_to_k, node, me):
+    """Install the sim's exact TAG_BCAST fanout draw on one node's
+    broadcast runtime (reference._bcast_target + draw_excluding, the
+    fanout_per_change policy): each pending payload — mapped back to its
+    sim changeset index via (actor, versions) — fans out to the SAME
+    per-(round, node, slot) hash-drawn targets as the sim, with the same
+    distinct-target exclusion chain and believed-down redraws.  Pairs
+    away the last unpaired randomness in the failure-mode experiments."""
+    assert p.nseq_max <= 1, "fanout pairing supports single-chunk payloads"
+    S = max(1, p.nseq_max)
+    attempts = p.swim_probe_attempts if p.swim else 1  # ref: reference.py
+    addr_of = [("127.0.0.1", cluster._ports[nm]) for nm in names]
+
+    def hook(payload):
+        try:
+            _kind, data = _wire.decode_uni(payload)
+        except _wire.WireError:
+            return None
+        change = data[0]
+        k = key_to_k.get((bytes(change.actor_id), change.changeset.versions))
+        if k is None:
+            return None
+        r = cluster.vround
+        ups = {(m.addr[0], m.addr[1]) for m in node.members.up_members()}
+        out, chosen = [], []
+        for j in range(p.fanout):
+            slot = j * S  # single-chunk payloads: s = 0
+            t_found = first = None
+            for a in range(attempts):
+                # the sim's own draw function IS the pairing source —
+                # any topology it supports pairs for free, and a keying
+                # change can never drift between the two
+                u = _ref_bcast_target(p, r, me, slot, k, a, chosen)
+                if first is None:
+                    first = u
+                if addr_of[u] in ups:
+                    t_found = u
+                    break
+            # mirror reference.draw_excluding: the FIRST candidate joins
+            # the exclusion chain even when every attempt was believed
+            # down (keeps later slots' draws bit-identical to the sim)
+            chosen.append(t_found if t_found is not None else first)
+            if t_found is not None:
+                out.append(addr_of[t_found])
+        return out
+
+    node.broadcast.draw_hook = hook
+
+
+def sim_death_schedule(p: SimParams):
+    """{round: [node, ...]} — the sim's exact churn draws for this seed."""
+    return {
+        x: [
+            n
+            for n in range(p.n_nodes)
+            if py_below(1_000_000, p.seed, TAG_CHURN, x, n) < p.churn_ppm
+        ]
+        for x in range(p.churn_rounds)
+    }
+
+
+def sim_origins(p: SimParams):
+    """Per-changeset origin nodes — the sim's exact TAG_ORIGIN draws."""
+    return [
+        py_below(p.n_nodes, p.seed, TAG_ORIGIN, k) for k in range(p.n_changes)
+    ]
+
+
+def sim_partition_sides(p: SimParams):
+    """Per-node partition side — the sim's exact TAG_PART draws."""
+    return [
+        1 if py_below(1_000_000, p.seed, TAG_PART, n) < p.partition_frac_ppm
+        else 0
+        for n in range(p.n_nodes)
+    ]
+
+
+def arm_node(node, trial_seed: int, i: int, next_probe_at: float = 0.0):
+    """Per-trial determinism: freeze RTT rings (loopback would put every
+    member in ring0 → broadcast-to-all) and seed the broadcast + SWIM
+    rngs."""
+    node.transport.on_rtt = None
+    for m in node.members.states.values():
+        m.ring = None
+        m.rtts.clear()
+    node.broadcast.rng = random.Random((trial_seed + 1) * 1000 + i)
+    node.swim.rng = random.Random((trial_seed + 1) * 77_000 + i)
+    node.swim._next_probe_at = next_probe_at

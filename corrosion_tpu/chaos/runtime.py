@@ -1,0 +1,204 @@
+"""Runtime fault injector: apply a lowered chaos schedule to a real
+:class:`~corrosion_tpu.harness.DevCluster` at round barriers.
+
+The injector is the harness-side executor of the tentpole contract
+(doc/chaos.md): it consumes the SAME :class:`LoweredChaos` arrays the
+sim gathers inside ``lax.scan``, and realizes each fault through the
+machinery the fidelity experiments already validated —
+``set_partition`` / ``heal_partition`` for the two-sided split,
+``kill`` / ``restart`` for crash-stop churn, and the sender-side fault
+hook (``DevCluster.set_fault_hook``) for per-link drop / duplicate /
+delay.  Link-fault verdicts replay the exact counter-based hash draws
+the sim makes (``TAG_CHAOS_DROP`` keyed on the schedule seed and the
+cluster's current virtual round), so a link the sim drops at round r is
+dropped at round r here too — agreement by construction, not by luck.
+
+SWIM probe datagrams are exempt from link faults (schedule.py module
+doc): probe targets are not paired between backends, and one dropped
+probe forks the membership trajectories.  Partition and crash are the
+membership-visible faults; link faults act on gossip (uni) and sync
+(bi) traffic.
+
+Telemetry: every fired verdict and lifecycle event increments
+``corro.chaos.injected.total{kind=...}`` and ``install()`` publishes
+the schedule identity on the ``corro.chaos.schedule.hash`` gauge (low
+48 hash bits — exact in the gauge's float64), so an operator can
+confirm WHICH schedule a run replayed (doc/telemetry.md).
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+from ..sim.rng import TAG_CHAOS_DROP, TAG_CHAOS_DUP, py_below
+from ..utils.metrics import counter, gauge
+from .lower import LoweredChaos
+
+__all__ = ["ChaosInjector"]
+
+# on_restart(round, node_index, node) — the comparator re-arms rngs,
+# reseeds membership, reinstalls pairing hooks and replays the node's
+# own writes here (chaos/compare.py); plain harness users can announce
+OnRestart = Callable[[int, int, object], Awaitable[None]]
+
+
+class ChaosInjector:
+    """Drive one DevCluster through a lowered schedule, one round
+    barrier at a time::
+
+        inj = ChaosInjector(cluster, lowered, names)
+        inj.install()
+        for r in range(rounds):
+            await inj.begin_round(r)      # restarts, partition edges
+            await cluster.step_round(r, ..., swim=True)
+            await inj.end_round(r)        # crash-stop kills
+            if not inj.outstanding_down and converged(...):
+                break
+
+    ``names[i]`` maps schedule node index i to the cluster's node name;
+    the injector derives the address map from ``cluster._ports`` so the
+    fault hook can translate ``(host, port)`` back to schedule indices.
+    """
+
+    def __init__(
+        self,
+        cluster,
+        lowered: LoweredChaos,
+        names: List[str],
+    ) -> None:
+        if len(names) != lowered.n_nodes:
+            raise ValueError(
+                f"names covers {len(names)} nodes, schedule has "
+                f"{lowered.n_nodes}"
+            )
+        self.cluster = cluster
+        self.lowered = lowered
+        self.names = list(names)
+        self._part_on = False
+        # killed-but-not-yet-restarted node names: convergence checks
+        # must not pass while a replacement (holding writes the cluster
+        # needs) has yet to boot
+        self.outstanding_down: set = set()
+        self._idx_of_addr: Dict[Tuple[str, int], int] = {
+            ("127.0.0.1", cluster._ports[nm]): i
+            for i, nm in enumerate(self.names)
+        }
+
+    # -- fault hook (drop / dup / delay on live traffic) ------------------
+
+    def install(self) -> None:
+        """Install the link-fault hook and publish the schedule hash."""
+        gauge("corro.chaos.schedule.hash").set(
+            float(self.lowered.schedule.hash_gauge_value())
+        )
+        lw = self.lowered
+        if (
+            lw.drop_ppm is None
+            and lw.dup_ppm is None
+            and lw.delay_rounds is None
+        ):
+            return  # partitions/crashes need no per-send hook
+        self.cluster.set_fault_hook(self._verdict)
+
+    def uninstall(self) -> None:
+        self.cluster.set_fault_hook(None)
+
+    def _verdict(self, src_addr, dst_addr, channel: str):
+        if channel == "datagram":
+            return None  # SWIM probes exempt (module doc)
+        lw = self.lowered
+        r = int(getattr(self.cluster, "vround", 0))
+        if not 0 <= r < lw.horizon:
+            return None
+        src = self._idx_of_addr.get(src_addr)
+        dst = self._idx_of_addr.get(dst_addr)
+        if src is None or dst is None:
+            return None
+        seed = lw.schedule.seed
+        if lw.drop_ppm is not None:
+            ppm = int(lw.drop_ppm[r, src, dst])
+            # ONE draw per (round, link), shared with the sim's
+            # link_up() gather — both backends agree per link per round
+            if ppm > 0 and py_below(
+                1_000_000, seed, TAG_CHAOS_DROP, r, src, dst
+            ) < ppm:
+                counter("corro.chaos.injected.total", kind="drop").inc()
+                return "drop"
+        if channel == "bi":
+            return None  # sync sessions honor drop only
+        if lw.dup_ppm is not None:
+            ppm = int(lw.dup_ppm[r, src, dst])
+            if ppm > 0 and py_below(
+                1_000_000, seed, TAG_CHAOS_DUP, r, src, dst
+            ) < ppm:
+                counter("corro.chaos.injected.total", kind="dup").inc()
+                return "dup"
+        if lw.delay_rounds is not None:
+            d = int(lw.delay_rounds[r, src, dst])
+            if d > 0:
+                counter("corro.chaos.injected.total", kind="delay").inc()
+                return ("delay", d)
+        return None
+
+    # -- round barriers ---------------------------------------------------
+
+    async def begin_round(
+        self, r: int, on_restart: Optional[OnRestart] = None
+    ) -> None:
+        """START-of-round events: boot replacements whose down window
+        closed (sim: a death at x announces at x+d+1), flip partition
+        state on its edges, update SWIM clock skew, and release delayed
+        sends that came due at this barrier."""
+        lw = self.lowered
+        if 0 <= r < lw.horizon:
+            for n in range(lw.n_nodes):
+                if lw.restart[r, n]:
+                    name = self.names[n]
+                    if name in self.cluster.nodes:
+                        continue  # explicit restart raced an earlier one
+                    node = await self.cluster.restart(name)
+                    self.outstanding_down.discard(name)
+                    counter(
+                        "corro.chaos.injected.total", kind="restart"
+                    ).inc()
+                    if on_restart is not None:
+                        await on_restart(r, n, node)
+            active = bool(lw.part_active[r])
+            if active and not self._part_on:
+                self.cluster.set_partition(
+                    {
+                        nm: int(lw.part_side[i])
+                        for i, nm in enumerate(self.names)
+                    }
+                )
+                self._part_on = True
+                counter(
+                    "corro.chaos.injected.total", kind="partition"
+                ).inc()
+            elif not active and self._part_on:
+                self.cluster.heal_partition()
+                self._part_on = False
+                counter("corro.chaos.injected.total", kind="heal").inc()
+            if lw.skew is not None:
+                for n in range(lw.n_nodes):
+                    addr = ("127.0.0.1", self.cluster._ports[self.names[n]])
+                    self.cluster.chaos_clock_skew[addr] = float(
+                        lw.skew[r, n]
+                    )
+        await self.cluster.release_delayed()
+
+    async def end_round(self, r: int) -> None:
+        """END-of-round events: crash-stop kills (sim: a death at round
+        x wipes at the end of x — the node participates in x)."""
+        lw = self.lowered
+        if not 0 <= r < lw.horizon:
+            return
+        for n in range(lw.n_nodes):
+            if lw.die[r, n]:
+                name = self.names[n]
+                if name in self.cluster.nodes:
+                    await self.cluster.kill(name)
+                    counter(
+                        "corro.chaos.injected.total", kind="crash"
+                    ).inc()
+                self.outstanding_down.add(name)

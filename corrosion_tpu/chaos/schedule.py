@@ -1,0 +1,383 @@
+"""Typed, deterministic fault schedules.
+
+A :class:`ChaosSchedule` is a list of per-round fault events — partition
+/ heal over arbitrary node sets, crash-stop with an explicit or
+auto-derived restart, per-link drop / delay / duplicate probability
+windows, and clock skew — plus the cluster size, the round horizon the
+schedule was written for, and the seed that keys every *execution-time*
+random decision (the per-(round, src, dst) link-fault draws).
+
+Two executors consume the SAME schedule object (doc/chaos.md):
+
+- the **runtime injector** (:mod:`corrosion_tpu.chaos.runtime`) applies
+  events to a real :class:`~corrosion_tpu.harness.DevCluster` at round
+  barriers through the harness's partition / kill / fault-hook
+  machinery;
+- the **sim lowerer** (:mod:`corrosion_tpu.chaos.lower`) compiles the
+  schedule into dense per-round mask tensors the JAX cluster simulator
+  and the scalar reference consume inside ``lax.scan`` /
+  ``lax.while_loop``.
+
+Determinism is the design center: :func:`generate` builds a schedule as
+a pure function of ``(seed, GenParams)`` using the counter-based hash of
+:mod:`corrosion_tpu.sim.rng` (TAG_CHAOS), serialization is canonical
+JSON, and :meth:`ChaosSchedule.schedule_hash` is the sha256 of that
+canonical form — same seed, same params ⇒ same hash, byte for byte.
+
+Event semantics (round r is one gossip round of sim/model.py):
+
+``partition``   at ``round``: ``nodes`` become side 1, everyone else
+                side 0; cross-side traffic drops until a ``heal``.
+``heal``        at ``round``: the active partition heals.
+``crash``       at ``round``: ``nodes`` are wiped to their own writes at
+                the END of round r (they participate in r), are
+                unresponsive for ``down_rounds`` rounds, and their
+                replacement announces at ``round + down_rounds + 1``.
+                ``down_rounds=-1`` means "until an explicit restart
+                event".  A crash landing on an already-down node
+                overwrites its recovery round (the sim's churn
+                semantics: overlapping death draws extend the window).
+``restart``     at ``round``: ``nodes`` (which must be down) boot their
+                replacements at the START of round r.
+``link``        rounds ``[round, until_round)``: traffic ``src → dst``
+                (empty set = all nodes) is dropped with ``drop_ppm``,
+                duplicated with ``duplicate_ppm``, or delayed by
+                ``delay_rounds`` round barriers.  Drop decisions hash
+                ``(seed, TAG_CHAOS_DROP, round, src, dst)`` — one draw
+                per link per round, shared by every payload on the link
+                and by BOTH executors, so the sim and the harness drop
+                the same links on the same rounds.  SWIM probe
+                datagrams are exempt from link faults (probe targets
+                are not paired between backends; a single dropped probe
+                would fork the membership trajectories — partitions and
+                crashes are the membership-visible faults).
+``clock_skew``  at ``round``: ``nodes`` run their SWIM virtual clock
+                ``skew_rounds`` rounds ahead (runtime injector only —
+                the round-synchronous sim has no clock to skew).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import asdict, dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+from ..sim.rng import TAG_CHAOS, TAG_PART, py_below
+
+__all__ = [
+    "ChaosEvent",
+    "ChaosSchedule",
+    "GenParams",
+    "KINDS",
+    "generate",
+    "from_sim_params",
+]
+
+PARTITION = "partition"
+HEAL = "heal"
+CRASH = "crash"
+RESTART = "restart"
+LINK = "link"
+CLOCK_SKEW = "clock_skew"
+
+KINDS = (PARTITION, HEAL, CRASH, RESTART, LINK, CLOCK_SKEW)
+
+# generation sub-streams under TAG_CHAOS (see sim/rng.py)
+_GEN_PART = 0
+_GEN_CRASH = 1
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One fault event.  Fields not meaningful for a kind stay at their
+    defaults (and serialize anyway — the canonical form is total, so the
+    schedule hash can never depend on serializer defaults)."""
+
+    round: int
+    kind: str
+    nodes: Tuple[int, ...] = ()
+    # crash: unresponsive rounds before auto-restart; -1 = explicit
+    down_rounds: int = 0
+    # link faults: active over [round, until_round)
+    until_round: int = 0
+    src: Tuple[int, ...] = ()
+    dst: Tuple[int, ...] = ()
+    drop_ppm: int = 0
+    duplicate_ppm: int = 0
+    delay_rounds: int = 0
+    # clock_skew: SWIM virtual-clock offset, in rounds
+    skew_rounds: int = 0
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        for k in ("nodes", "src", "dst"):
+            d[k] = list(d[k])
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "ChaosEvent":
+        d = dict(d)
+        for k in ("nodes", "src", "dst"):
+            d[k] = tuple(d.get(k) or ())
+        return ChaosEvent(**d)
+
+
+@dataclass(frozen=True)
+class ChaosSchedule:
+    """An ordered fault schedule for an ``n_nodes`` cluster over
+    ``n_rounds`` rounds.  ``seed`` keys the execution-time link-fault
+    draws (NOT the event list — that is fixed here, whatever produced
+    it)."""
+
+    n_nodes: int
+    n_rounds: int
+    seed: int
+    events: Tuple[ChaosEvent, ...] = field(default_factory=tuple)
+
+    # -- canonical form ----------------------------------------------------
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        """Canonical JSON: sorted keys, events in (round, kind, nodes)
+        order, no whitespace variance at ``indent=None`` — the form the
+        schedule hash is computed over."""
+        doc = {
+            "n_nodes": self.n_nodes,
+            "n_rounds": self.n_rounds,
+            "seed": self.seed,
+            "events": [e.to_dict() for e in self.sorted_events()],
+        }
+        return json.dumps(doc, sort_keys=True, indent=indent)
+
+    @staticmethod
+    def from_json(text: str) -> "ChaosSchedule":
+        doc = json.loads(text)
+        return ChaosSchedule(
+            n_nodes=int(doc["n_nodes"]),
+            n_rounds=int(doc["n_rounds"]),
+            seed=int(doc["seed"]),
+            events=tuple(
+                ChaosEvent.from_dict(e) for e in doc.get("events", ())
+            ),
+        )
+
+    def sorted_events(self) -> List[ChaosEvent]:
+        return sorted(
+            self.events, key=lambda e: (e.round, KINDS.index(e.kind), e.nodes)
+        )
+
+    def schedule_hash(self) -> str:
+        """sha256 hex of the canonical JSON form."""
+        return hashlib.sha256(self.to_json().encode()).hexdigest()
+
+    def hash_gauge_value(self) -> int:
+        """The hash folded to its low 48 bits as an int — exact in the
+        float64 a Prometheus gauge carries (chaos_schedule_hash)."""
+        return int(self.schedule_hash()[:12], 16)
+
+    def with_(self, **kw) -> "ChaosSchedule":
+        return replace(self, **kw)
+
+    # -- validation --------------------------------------------------------
+
+    def validate(self) -> None:
+        """Structural checks every executor relies on.  Raises
+        ``ValueError`` with the first offense."""
+        if self.n_nodes < 2:
+            raise ValueError("chaos schedule needs n_nodes >= 2")
+        if self.n_rounds < 1:
+            raise ValueError("chaos schedule needs n_rounds >= 1")
+        part_open = False
+        down: Dict[int, int] = {}  # node -> revive round (or a big int)
+        for e in self.sorted_events():
+            if e.kind not in KINDS:
+                raise ValueError(f"unknown event kind {e.kind!r}")
+            if not 0 <= e.round < self.n_rounds:
+                raise ValueError(
+                    f"{e.kind} round {e.round} outside [0, {self.n_rounds})"
+                )
+            for n in (*e.nodes, *e.src, *e.dst):
+                if not 0 <= n < self.n_nodes:
+                    raise ValueError(f"{e.kind} names node {n} out of range")
+            # revive auto-restarts due before this event
+            for n, rr in list(down.items()):
+                if rr <= e.round:
+                    del down[n]
+            if e.kind == PARTITION:
+                if part_open:
+                    raise ValueError(
+                        f"partition at round {e.round} while one is active"
+                    )
+                if not 0 < len(set(e.nodes)) < self.n_nodes:
+                    raise ValueError("partition side must be a proper subset")
+                part_open = True
+            elif e.kind == HEAL:
+                if not part_open:
+                    raise ValueError(f"heal at round {e.round} with no partition")
+                part_open = False
+            elif e.kind == CRASH:
+                if not e.nodes:
+                    raise ValueError("crash event names no nodes")
+                if e.down_rounds < -1:
+                    raise ValueError("crash down_rounds must be >= -1")
+                for n in e.nodes:
+                    down[n] = (
+                        self.n_rounds + 1
+                        if e.down_rounds < 0
+                        else e.round + e.down_rounds + 1
+                    )
+            elif e.kind == RESTART:
+                for n in e.nodes:
+                    if n not in down:
+                        raise ValueError(
+                            f"restart at round {e.round}: node {n} is not down"
+                        )
+                    del down[n]
+            elif e.kind == LINK:
+                if e.until_round <= e.round:
+                    raise ValueError("link fault needs until_round > round")
+                if not (
+                    e.drop_ppm or e.duplicate_ppm or e.delay_rounds
+                ):
+                    raise ValueError("link fault with no effect")
+                for ppm in (e.drop_ppm, e.duplicate_ppm):
+                    if not 0 <= ppm <= 1_000_000:
+                        raise ValueError("link ppm outside [0, 1e6]")
+                if e.delay_rounds < 0:
+                    raise ValueError("link delay_rounds must be >= 0")
+            elif e.kind == CLOCK_SKEW:
+                if not e.nodes:
+                    raise ValueError("clock_skew event names no nodes")
+
+
+# -- generation ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class GenParams:
+    """Knobs for :func:`generate`.  A schedule is a pure function of
+    this dataclass — same values, same schedule, same hash."""
+
+    n_nodes: int
+    n_rounds: int
+    seed: int = 0
+    # two-sided partition over [partition_from, partition_from + partition_rounds)
+    partition_frac_ppm: int = 0  # P(node on side 1), ppm
+    partition_from: int = 0
+    partition_rounds: int = 0
+    # crash-stop churn: per-round per-node draw over [0, crash_rounds)
+    crash_ppm: int = 0
+    crash_rounds: int = 0
+    crash_down_rounds: int = 2
+    # uniform link-drop window over [drop_from, drop_from + drop_rounds)
+    drop_ppm: int = 0
+    drop_from: int = 0
+    drop_rounds: int = 0
+    # uniform link-duplicate window (same window as drop)
+    duplicate_ppm: int = 0
+
+
+def generate(gp: GenParams) -> ChaosSchedule:
+    """Build a schedule from ``gp`` with the counter-based hash — a pure
+    function of ``(gp.seed, gp)``; draws are domain-separated under
+    TAG_CHAOS so they perturb no simulator stream."""
+    events: List[ChaosEvent] = []
+    N, R, seed = gp.n_nodes, gp.n_rounds, gp.seed
+
+    if gp.partition_frac_ppm > 0 and gp.partition_rounds > 0:
+        side1 = tuple(
+            n
+            for n in range(N)
+            if py_below(1_000_000, seed, TAG_CHAOS, _GEN_PART, n)
+            < gp.partition_frac_ppm
+        )
+        if 0 < len(side1) < N:
+            heal_at = min(gp.partition_from + gp.partition_rounds, R - 1)
+            if heal_at > gp.partition_from:
+                events.append(
+                    ChaosEvent(
+                        round=gp.partition_from, kind=PARTITION, nodes=side1
+                    )
+                )
+                events.append(ChaosEvent(round=heal_at, kind=HEAL))
+
+    if gp.crash_ppm > 0 and gp.crash_rounds > 0:
+        for x in range(min(gp.crash_rounds, R)):
+            victims = tuple(
+                n
+                for n in range(N)
+                if py_below(1_000_000, seed, TAG_CHAOS, _GEN_CRASH, x, n)
+                < gp.crash_ppm
+            )
+            if victims:
+                events.append(
+                    ChaosEvent(
+                        round=x,
+                        kind=CRASH,
+                        nodes=victims,
+                        down_rounds=gp.crash_down_rounds,
+                    )
+                )
+
+    if gp.drop_rounds > 0 and (gp.drop_ppm > 0 or gp.duplicate_ppm > 0):
+        until = min(gp.drop_from + gp.drop_rounds, R)
+        if until > gp.drop_from:
+            events.append(
+                ChaosEvent(
+                    round=gp.drop_from,
+                    kind=LINK,
+                    until_round=until,
+                    drop_ppm=gp.drop_ppm,
+                    duplicate_ppm=gp.duplicate_ppm,
+                )
+            )
+
+    sched = ChaosSchedule(
+        n_nodes=N, n_rounds=R, seed=seed, events=tuple(events)
+    )
+    sched.validate()
+    return sched
+
+
+def from_sim_params(p) -> ChaosSchedule:
+    """Re-express a :class:`~corrosion_tpu.sim.model.SimParams` churn +
+    partition configuration as an explicit schedule, replaying the SAME
+    TAG_PART / TAG_CHURN draws the simulator makes — so
+    ``run(p_clean, chaos=lower(from_sim_params(p), p_clean))`` is
+    bit-identical to ``run(p)``: the ad-hoc ``churn_ppm`` /
+    ``partition_frac_ppm`` scalars are degenerate cases of the schedule
+    model (asserted by tests/test_chaos.py)."""
+    from ..sim.rng import TAG_CHURN
+
+    events: List[ChaosEvent] = []
+    N = p.n_nodes
+    if p.partition_frac_ppm > 0 and p.partition_rounds > 0:
+        side1 = tuple(
+            n
+            for n in range(N)
+            if py_below(1_000_000, p.seed, TAG_PART, n) < p.partition_frac_ppm
+        )
+        if 0 < len(side1) < N and p.partition_rounds < p.max_rounds:
+            events.append(ChaosEvent(round=0, kind=PARTITION, nodes=side1))
+            events.append(ChaosEvent(round=p.partition_rounds, kind=HEAL))
+    if p.churn_ppm > 0 and p.churn_rounds > 0:
+        for x in range(p.churn_rounds):
+            victims = tuple(
+                n
+                for n in range(N)
+                if py_below(1_000_000, p.seed, TAG_CHURN, x, n) < p.churn_ppm
+            )
+            if victims:
+                events.append(
+                    ChaosEvent(
+                        round=x,
+                        kind=CRASH,
+                        nodes=victims,
+                        down_rounds=p.churn_down_rounds,
+                    )
+                )
+    sched = ChaosSchedule(
+        n_nodes=N, n_rounds=p.max_rounds, seed=p.seed, events=tuple(events)
+    )
+    sched.validate()
+    return sched

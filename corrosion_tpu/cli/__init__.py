@@ -409,6 +409,89 @@ async def cmd_lint(args) -> int:
     return exit_code(findings, fail_on=args.fail_on)
 
 
+async def cmd_chaos(args) -> int:
+    """``chaos gen|run|compare`` — deterministic fault schedules
+    (doc/chaos.md).  Needs no config file: schedules are self-contained
+    and both executors boot their own clusters."""
+    import json as _json
+
+    from ..chaos import GenParams, generate, lower
+    from ..chaos.schedule import ChaosSchedule
+
+    def _load(path: str) -> ChaosSchedule:
+        with open(path, "r", encoding="utf-8") as f:
+            sched = ChaosSchedule.from_json(f.read())
+        sched.validate()
+        return sched
+
+    if args.chaos_cmd == "gen":
+        sched = generate(
+            GenParams(
+                n_nodes=args.nodes,
+                n_rounds=args.rounds,
+                seed=args.seed,
+                partition_frac_ppm=args.partition_ppm,
+                partition_from=args.partition_from,
+                partition_rounds=args.partition_rounds,
+                crash_ppm=args.crash_ppm,
+                crash_rounds=args.crash_rounds,
+                crash_down_rounds=args.crash_down_rounds,
+                drop_ppm=args.drop_ppm,
+                drop_from=args.drop_from,
+                drop_rounds=args.drop_rounds,
+                duplicate_ppm=args.duplicate_ppm,
+            )
+        )
+        text = sched.to_json(indent=2)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+            print(f"wrote {args.out} (hash {sched.schedule_hash()})")
+        else:
+            print(text)
+            print(f"# schedule_hash {sched.schedule_hash()}", file=sys.stderr)
+        return 0
+
+    if args.chaos_cmd == "run":
+        sched = _load(args.schedule)
+        lowered = lower(sched)
+        out = {
+            "schedule_hash": sched.schedule_hash(),
+            "summary": lowered.summarize(),
+        }
+        if args.backend == "sim":
+            from ..chaos.compare import params_for, sim_rounds
+
+            p = params_for(sched, sync_interval=args.sync_interval)
+            out["backend"] = "sim"
+            out["rounds"] = sim_rounds(sched, p)
+        else:
+            from ..chaos.compare import harness_run, params_for
+
+            p = params_for(sched, sync_interval=args.sync_interval)
+            hr = await harness_run(sched, p)
+            out["backend"] = "harness"
+            out["rounds"] = hr.rounds
+            out["ledger_digest"] = hr.ledger_digest
+            out["membership_digest"] = hr.membership_digest
+        print(_json.dumps(out, indent=2))
+        return 0 if out["rounds"] is not None else 1
+
+    if args.chaos_cmd == "compare":
+        from ..chaos.compare import compare, params_for
+
+        sched = _load(args.schedule)
+        p = params_for(sched, sync_interval=args.sync_interval)
+        res = await compare(sched, p)
+        print(_json.dumps(res.to_dict(), indent=2))
+        if res.gap is None:
+            return 1
+        return 0 if res.gap <= args.tolerance else 1
+
+    _die(f"unknown chaos subcommand {args.chaos_cmd!r}")
+    return 2
+
+
 def _cell_str(cell: Any) -> str:
     if cell is None:
         return ""
@@ -535,6 +618,69 @@ def build_parser() -> argparse.ArgumentParser:
         "--consul-addr", default="http://127.0.0.1:8500"
     )
     sp.set_defaults(fn=cmd_consul)
+
+    sp = sub.add_parser(
+        "chaos",
+        help="deterministic fault injection: generate / replay / compare "
+        "schedules (doc/chaos.md)",
+    )
+    hsub = sp.add_subparsers(dest="chaos_cmd", required=True)
+    gen = hsub.add_parser(
+        "gen", help="generate a schedule from (seed, params)"
+    )
+    gen.add_argument("--nodes", type=int, required=True)
+    gen.add_argument("--rounds", type=int, required=True)
+    gen.add_argument("--seed", type=int, default=0)
+    gen.add_argument(
+        "--partition-ppm",
+        type=int,
+        default=0,
+        help="P(node on side 1) in ppm; 0 disables the partition",
+    )
+    gen.add_argument("--partition-from", type=int, default=0)
+    gen.add_argument("--partition-rounds", type=int, default=0)
+    gen.add_argument(
+        "--crash-ppm",
+        type=int,
+        default=0,
+        help="per-round per-node crash probability in ppm",
+    )
+    gen.add_argument("--crash-rounds", type=int, default=0)
+    gen.add_argument("--crash-down-rounds", type=int, default=2)
+    gen.add_argument(
+        "--drop-ppm",
+        type=int,
+        default=0,
+        help="per-link per-round drop probability in ppm",
+    )
+    gen.add_argument("--drop-from", type=int, default=0)
+    gen.add_argument("--drop-rounds", type=int, default=0)
+    gen.add_argument("--duplicate-ppm", type=int, default=0)
+    gen.add_argument("-o", "--out", help="write the schedule JSON here")
+    run = hsub.add_parser(
+        "run", help="replay a schedule on one executor"
+    )
+    run.add_argument("schedule", help="schedule JSON file (from `chaos gen`)")
+    run.add_argument(
+        "--backend",
+        choices=("sim", "harness"),
+        default="sim",
+        help="sim = scalar reference (no accelerator); harness = real "
+        "DevCluster with the runtime injector",
+    )
+    run.add_argument("--sync-interval", type=int, default=3)
+    cmp_ = hsub.add_parser(
+        "compare", help="replay on BOTH executors and report the gap"
+    )
+    cmp_.add_argument("schedule")
+    cmp_.add_argument("--sync-interval", type=int, default=3)
+    cmp_.add_argument(
+        "--tolerance",
+        type=float,
+        default=0.02,
+        help="max |harness-sim|/sim round gap for exit 0 (default 0.02)",
+    )
+    sp.set_defaults(fn=cmd_chaos)
 
     sp = sub.add_parser("tls", help="certificate generation")
     tsub = sp.add_subparsers(dest="tls_cmd", required=True)

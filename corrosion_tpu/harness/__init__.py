@@ -137,6 +137,20 @@ class DevCluster:
         # partition (sim/model.py step 7)
         self._part_sides: Dict[Tuple[str, int], int] = {}
         self._part_active = False
+        # -- chaos fault hook ---------------------------------------------
+        # (src_addr, dst_addr, channel) -> None | "drop" | "dup" |
+        # ("delay", n_rounds); consulted by the same sender-side filter
+        # that implements partitions, OUTSIDE the delivery ledger, so
+        # dropped traffic is never counted as expected and delayed
+        # traffic is counted when it is actually released.  channel is
+        # "datagram" (SWIM), "uni" (broadcast) or "bi" (sync session
+        # open; only "drop" is honored there — it surfaces as
+        # ConnectionError, like a partitioned connect).  Installed by
+        # chaos/runtime.py's injector (doc/chaos.md).
+        self._fault_hook = None
+        # delayed sends parked until release_delayed(): [rounds_left, fn]
+        self._delayed: list = []
+        self.chaos_clock_skew: Dict[Tuple[str, int], float] = {}
         # killed nodes' ports, re-bound as placeholders until restart
         self._parked_socks: Dict[str, tuple] = {}
 
@@ -218,38 +232,87 @@ class DevCluster:
     def heal_partition(self) -> None:
         self._part_active = False
 
+    def set_fault_hook(self, hook) -> None:
+        """Install (or clear, with ``None``) the chaos fault hook — see
+        the ``_fault_hook`` note in ``__init__``.  The hook must be
+        deterministic in its arguments plus whatever round counter the
+        caller advances between barriers (chaos/runtime.py keys verdicts
+        on counter-based hash draws so paired runs agree)."""
+        self._fault_hook = hook
+
+    async def release_delayed(self) -> None:
+        """Round barrier for delayed sends: age every parked send by one
+        round and fire the ones that are due (through the ledger-wrapped
+        inner send, so they are counted as expected when they actually
+        enter the network)."""
+        still = []
+        for left, fn in self._delayed:
+            left -= 1
+            if left <= 0:
+                with contextlib.suppress(OSError, ConnectionError):
+                    await fn()
+            else:
+                still.append([left, fn])
+        self._delayed = still
+
+    def _verdict(self, my_addr, dest, channel: str):
+        """Combined partition + chaos-hook verdict for one send."""
+        if self._part_active:
+            a = self._part_sides.get(my_addr)
+            b = self._part_sides.get(dest)
+            if a is not None and b is not None and a != b:
+                return "drop"
+        if self._fault_hook is not None:
+            return self._fault_hook(my_addr, dest, channel)
+        return None
+
     def _install_partition_filter(self, node) -> None:
-        """Sender-side cross-partition drop.  Installed OUTSIDE the
-        delivery ledger's wrappers (after :meth:`_instrument`), so dropped
-        traffic is never counted as expected."""
+        """Sender-side fault filter: cross-partition drops plus the chaos
+        hook's drop/duplicate/delay verdicts.  Installed OUTSIDE the
+        delivery ledger's wrappers (after :meth:`_instrument`), so
+        dropped traffic is never counted as expected, duplicates are
+        counted twice, and delayed sends are counted at release."""
         tp = node.transport
         my_addr = (node.transport.host, node.transport.port)
-
-        def blocked(dest) -> bool:
-            if not self._part_active:
-                return False
-            a = self._part_sides.get(my_addr)
-            b = self._part_sides.get((dest[0], dest[1]))
-            return a is not None and b is not None and a != b
 
         orig_dg = tp.send_datagram
 
         def send_dg(addr, payload, _o=orig_dg):
-            if not blocked(addr):
+            v = self._verdict(my_addr, (addr[0], addr[1]), "datagram")
+            if v == "drop":
+                return
+            if isinstance(v, tuple) and v[0] == "delay":
+
+                async def later(_o=_o, addr=addr, payload=payload):
+                    _o(addr, payload)
+
+                self._delayed.append([int(v[1]), later])
+                return
+            _o(addr, payload)
+            if v == "dup":
                 _o(addr, payload)
 
         tp.send_datagram = send_dg
         orig_uni = tp.send_uni
 
         async def send_uni(addr, payload, _o=orig_uni):
-            if not blocked(addr):
+            v = self._verdict(my_addr, (addr[0], addr[1]), "uni")
+            if v == "drop":
+                return
+            if isinstance(v, tuple) and v[0] == "delay":
+                self._delayed.append(
+                    [int(v[1]), lambda: _o(addr, payload)]
+                )
+                return
+            await _o(addr, payload)
+            if v == "dup":
                 await _o(addr, payload)
 
         tp.send_uni = send_uni
         orig_bi = tp.open_bi
 
         async def open_bi(addr, _o=orig_bi):
-            if blocked(addr):
+            if self._verdict(my_addr, (addr[0], addr[1]), "bi") == "drop":
                 raise ConnectionError("cluster partitioned (harness filter)")
             return await _o(addr)
 
@@ -557,10 +620,15 @@ class DevCluster:
             vnow = float(r) + sub
             live = list(self.nodes.values())
             # tick everyone BEFORE any pump: all probe draws see the
-            # pre-round views, like the sim's synchronous step
+            # pre-round views, like the sim's synchronous step.  A node
+            # under a chaos clock_skew event runs its SWIM clock ahead
+            # by that many virtual rounds (chaos/runtime.py)
             for node in live:
-                node.swim_vnow = vnow
-                node.swim.tick(vnow)
+                skew = self.chaos_clock_skew.get(
+                    (node.transport.host, node.transport.port), 0.0
+                )
+                node.swim_vnow = vnow + skew
+                node.swim.tick(vnow + skew)
             for node in live:
                 await node._pump_swim()
             await self._pump_datagrams()

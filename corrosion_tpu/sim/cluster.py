@@ -50,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .model import ALIVE, COMPLETE, DOWN, ER, POWERLAW, SUSPECT, SimParams
 from .rng import (
     TAG_BCAST,
+    TAG_CHAOS_DROP,
     TAG_CHURN,
     TAG_INJECT,
     TAG_ORIGIN,
@@ -109,12 +110,46 @@ def complete_mask(state_cov: jnp.ndarray, p: SimParams) -> jnp.ndarray:
     return state_cov == full[None, :]
 
 
-def make_step(p: SimParams):
-    """Build the jittable one-round transition for params ``p``."""
+def make_step(p: SimParams, chaos=None):
+    """Build the jittable one-round transition for params ``p``.
+
+    ``chaos`` is an optional :class:`corrosion_tpu.chaos.LoweredChaos`:
+    an explicit fault schedule compiled to dense per-round tensors.
+    When given, liveness / wipe / restart / partition come from
+    round-indexed gathers into the lowered arrays instead of the ad-hoc
+    ``churn_ppm`` / ``partition_frac_ppm`` hash draws (which the
+    schedule model subsumes — ``chaos.from_sim_params`` re-derives the
+    exact same trajectories, asserted in tests/test_chaos.py), and
+    per-link drop masks gate broadcast delivery and anti-entropy
+    sessions with TAG_CHAOS_DROP draws keyed by
+    ``(schedule.seed, round, src, dst)`` — the SAME draws the runtime
+    injector consults, so both executors drop the same links.  SWIM
+    probes are exempt from link drops: probe targets are not paired
+    across backends, and a single dropped probe would fork the
+    membership trajectories (doc/chaos.md)."""
     N, K, S = p.n_nodes, p.n_changes, max(1, p.nseq_max)
     T8 = jnp.int8(p.max_transmissions)
     D = p.churn_down_rounds
     origin, inject_round, part = _consts(p)
+    # graftlint: disable=GL101 (static build-time branch: chaos is a host dataclass bound via partial, never a tracer)
+    if chaos is not None:
+        chaos.require_sim_lowerable()
+        assert chaos.n_nodes == N, "chaos schedule sized for another cluster"
+        assert p.churn_ppm == 0 and p.partition_frac_ppm == 0, (
+            "explicit chaos schedules replace the ad-hoc churn/partition "
+            "scalars; zero them out (schedule.from_sim_params bridges)"
+        )
+        part = jnp.asarray(chaos.part_side)
+        c_dead = jnp.asarray(chaos.dead)
+        c_die = jnp.asarray(chaos.die)
+        c_restart = jnp.asarray(chaos.restart)
+        c_pact = jnp.asarray(chaos.part_active)
+        c_drop = (
+            jnp.asarray(chaos.drop_ppm) if chaos.drop_ppm is not None else None
+        )
+        c_seed = chaos.schedule.seed
+    else:
+        c_drop = None
     narange = jnp.arange(N, dtype=jnp.int32)
     karange = jnp.arange(K, dtype=jnp.int32)
     full = jnp.asarray(syncmod.full_masks(p))
@@ -232,18 +267,34 @@ def make_step(p: SimParams):
         return t + (t >= narange)  # skip self
 
     per_node = p.swim and p.swim_per_node_views
-    if per_node:
-        assert p.partition_frac_ppm == 0, (
-            "per-node views do not model partitions yet"
-        )
 
     def step(state: SimState) -> SimState:
         cov, budget, status, since, r = state
-        alive = alive_at(r)
-        restarted = jnp.logical_and(alive, jnp.logical_not(alive_at(r - 1)))
-        # effective partition side (all-zero once healed)
-        part_active = r < p.partition_rounds
+        if chaos is not None:
+            # liveness / restart / partition gathers into the lowered
+            # schedule tensors (constants folded into the executable)
+            alive = jnp.logical_not(c_dead[r])
+            restarted = c_restart[r]
+            part_active = c_pact[r]
+        else:
+            alive = alive_at(r)
+            restarted = jnp.logical_and(
+                alive, jnp.logical_not(alive_at(r - 1))
+            )
+            # effective partition side (all-zero once healed)
+            part_active = r < p.partition_rounds
         pvec = jnp.where(part_active, part, jnp.int8(0))
+
+        if c_drop is not None:
+            dppm = c_drop[r]  # int32[N, N] drop probability this round
+
+            def link_up(src, dst):
+                """bool: link src→dst carries traffic this round — one
+                TAG_CHAOS_DROP draw per (round, src, dst), shared by
+                every payload on the link and by the runtime injector
+                (chaos/runtime.py makes the same py_below draw)."""
+                v = jx_below(1_000_000, c_seed, TAG_CHAOS_DROP, r, src, dst)
+                return v >= dppm[src, dst]
         # viewer selector for draw_excluding's down2[viewer, target]
         # gather: the partition side label in consensus mode, the node's
         # own index in per-node mode — the indexing code is identical
@@ -278,8 +329,12 @@ def make_step(p: SimParams):
             # max of encoded (since*3 + state) keys, then restart seeding
             target, found = draw_excluding(down2, narange, probe_draw)
             probing = jnp.logical_and(alive, found)
-            succ_edge = jnp.logical_and(probing, alive[target])
-            fail = jnp.logical_and(probing, jnp.logical_not(alive[target]))
+            # a probe crossing an active partition cut fails like a dead
+            # target would (pvec is all-zero when no partition is active,
+            # so the term vanishes and pre-partition runs are unchanged)
+            edge_ok = jnp.logical_and(alive[target], pvec == pvec[target])
+            succ_edge = jnp.logical_and(probing, edge_ok)
+            fail = jnp.logical_and(probing, jnp.logical_not(edge_ok))
             # stage A: expiry on live viewers' rows
             expire = jnp.logical_and(
                 status == SUSPECT, r - since >= p.swim_suspicion_rounds
@@ -331,9 +386,33 @@ def make_step(p: SimParams):
             row_new = jnp.where(alive, jnp.int8(ALIVE), jnp.int8(DOWN))
             status = jnp.where(restarted[:, None], row_new[None, :], status)
             since = jnp.where(restarted[:, None], r, since)
-            ann_col = jnp.logical_and(alive[:, None], restarted[None, :])
+            # restart announces only cross reachable links (no-op without
+            # an active partition: pvec is all-zero then)
+            same_side = pvec[:, None] == pvec[None, :]
+            ann_col = jnp.logical_and(
+                jnp.logical_and(alive[:, None], restarted[None, :]),
+                same_side,
+            )
             status = jnp.where(ann_col, jnp.int8(ALIVE), status)
             since = jnp.where(ann_col, r, since)
+            # post-heal rejoin: a live viewer still holding a live node
+            # DOWN (cross-side suspicion expiry while partitioned) adopts
+            # its announce after the rejoin lag — the per-node mirror of
+            # the consensus branch's announce term.  Under pure churn
+            # this never fires: DOWN beliefs about live nodes cannot
+            # form without a partition cut (restart announces land the
+            # same round the node revives)
+            rej = jnp.logical_and(
+                jnp.logical_and(
+                    status == DOWN, r - since >= p.swim_rejoin_rounds
+                ),
+                jnp.logical_and(
+                    jnp.logical_and(alive[:, None], alive[None, :]),
+                    same_side,
+                ),
+            )
+            status = jnp.where(rej, jnp.int8(ALIVE), status)
+            since = jnp.where(rej, r, since)
             down2 = status == DOWN
         elif p.swim:
             target, found = draw_excluding(down2, view, probe_draw)
@@ -424,6 +503,8 @@ def make_step(p: SimParams):
                         jnp.logical_and(found, pvec[:, None] == pvec[t]),
                         alive[t],
                     )
+                    if c_drop is not None:
+                        ok = jnp.logical_and(ok, link_up(nvec, t))
                     plane = plane.at[t, kk].max(hold & ok)
                     chosen.append(t)
             else:
@@ -437,6 +518,8 @@ def make_step(p: SimParams):
                     ok = jnp.logical_and(
                         jnp.logical_and(found, pvec == pvec[t]), alive[t]
                     )
+                    if c_drop is not None:
+                        ok = jnp.logical_and(ok, link_up(narange, t))
                     plane = plane.at[t].max(hold & ok[:, None])
             delivered = delivered | jnp.where(plane, bit, jnp.uint8(0))
 
@@ -471,6 +554,9 @@ def make_step(p: SimParams):
                 jnp.logical_and(found, pvec == pvec[q]),
                 jnp.logical_and(alive, alive[q]),
             )
+            if c_drop is not None:
+                # the whole pull session rides the initiator→peer link
+                okq = jnp.logical_and(okq, link_up(narange, q))
             heads_mine = syncmod.jx_heads(cov, aidx, vidx, n_actors)
             avail = syncmod.jx_available(
                 cov, cov[q], full, heads_mine, aidx, vidx
@@ -479,10 +565,18 @@ def make_step(p: SimParams):
             do = jnp.logical_and((r + 1) % p.sync_interval == 0, okq)
             cov = jnp.where(do[:, None], cov | pulled, cov)
 
-        # 6. churn: hash-selected deaths wipe to own writes (replacement
-        # node re-registering); the node stays unresponsive for D rounds
-        if p.churn_ppm > 0 and p.churn_rounds > 0:
+        # 6. churn: deaths wipe to own writes (replacement node
+        # re-registering); the node stays unresponsive for D rounds.
+        # Hash-selected under the ad-hoc scalars, schedule-driven under
+        # an explicit chaos schedule
+        die = None
+        if chaos is not None:
+            if chaos.any_die():
+                die = c_die[r]
+        elif p.churn_ppm > 0 and p.churn_rounds > 0:
             die = death(r)
+        # graftlint: disable=GL101 (identity check on whether a wipe plane exists this trace — decided at trace time, not a tracer comparison)
+        if die is not None:
             # own[n, k]: changeset k originates at n (restart survivors);
             # computed in-step so it fuses instead of sitting as an [N, K]
             # constant in the executable
@@ -500,8 +594,8 @@ def make_step(p: SimParams):
     return step
 
 
-def _run_loop(p: SimParams, state: SimState) -> SimState:
-    step = make_step(p)
+def _run_loop(p: SimParams, state: SimState, chaos=None) -> SimState:
+    step = make_step(p, chaos=chaos)
     full = jnp.asarray(syncmod.full_masks(p))
 
     def cond(state):
@@ -548,10 +642,17 @@ def run(
     mesh: Optional[Mesh] = None,
     mesh_axis: str = "nodes",
     return_state: bool = False,
+    chaos=None,
 ) -> SimResult:
     """Run to convergence (or max_rounds); returns timing split into
     compile and execute so the <60 s north star is measured on execute+
-    compile both (BASELINE.md reports wall-clock)."""
+    compile both (BASELINE.md reports wall-clock).  ``chaos`` threads an
+    explicit fault schedule into the step (see :func:`make_step`)."""
+    if chaos is not None:
+        assert chaos.horizon >= p.max_rounds, (
+            "lower(sched, horizon=p.max_rounds) so round gathers stay "
+            "in bounds (XLA clamps out-of-range indices silently)"
+        )
     state = init_state(p)
     if mesh is not None:
         shardings = state_shardings(p, mesh, node_axis=mesh_axis)
@@ -560,12 +661,12 @@ def run(
             for x, s in zip(state, shardings)
         )
         fn = jax.jit(
-            partial(_run_loop, p),
+            partial(_run_loop, p, chaos=chaos),
             in_shardings=(shardings,),
             out_shardings=shardings,
         )
     else:
-        fn = jax.jit(partial(_run_loop, p))
+        fn = jax.jit(partial(_run_loop, p, chaos=chaos))
     t0 = time.perf_counter()
     compiled = fn.lower(state).compile()
     t1 = time.perf_counter()
@@ -587,10 +688,19 @@ def run(
     )
 
 
-def run_trace(p: SimParams, n_rounds: Optional[int] = None) -> SimResult:
-    """Fixed-round scan recording per-round complete-coverage (analysis)."""
+def run_trace(
+    p: SimParams, n_rounds: Optional[int] = None, chaos=None
+) -> SimResult:
+    """Fixed-round scan recording per-round complete-coverage (analysis).
+    With ``chaos``, the schedule's lowered mask tensors ride through the
+    ``lax.scan`` as round-indexed gathers inside the step body."""
     n_rounds = p.max_rounds if n_rounds is None else n_rounds
-    step = make_step(p)
+    if chaos is not None:
+        assert chaos.horizon >= n_rounds, (
+            "lower(sched, horizon=n_rounds) before tracing past the "
+            "schedule's own horizon"
+        )
+    step = make_step(p, chaos=chaos)
     full = jnp.asarray(syncmod.full_masks(p))
 
     def body(state, _):

@@ -35,8 +35,10 @@ calls for.  Per round, in order:
    the consensus view cannot (at 48 nodes with overlapping suspicion
    epochs it matches the real runtime seed-for-seed where consensus
    diverges on one seed; both models hold the ±2% bar,
-   tests/test_sim_vs_harness.py).  Per-node views are O(N²) memory and
-   do not model partitions.
+   tests/test_sim_vs_harness.py).  Per-node views are O(N²) memory; both
+   view models support partitions (scalar ``partition_frac_ppm`` and
+   explicit ``corrosion_tpu.chaos`` schedules alike,
+   tests/test_chaos.py).
 3. *Broadcast*: every live node with budgeted chunks sends each held
    (changeset, chunk) payload to ``fanout`` targets it believes up.
    Two draw policies, both validated against the real agent runtime by

@@ -27,6 +27,7 @@ from typing import List, Optional, Set
 from .model import ALIVE, COMPLETE, DOWN, ER, POWERLAW, SUSPECT, SimParams
 from .rng import (
     TAG_BCAST,
+    TAG_CHAOS_DROP,
     TAG_CHURN,
     TAG_INJECT,
     TAG_ORIGIN,
@@ -124,7 +125,15 @@ def _sync_peer(p: SimParams, r: int, n: int, a: int) -> int:
     return q + 1 if q >= n else q
 
 
-def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
+def run_reference(
+    p: SimParams, max_rounds: Optional[int] = None, chaos=None
+) -> RefResult:
+    """Scalar mirror of :func:`corrosion_tpu.sim.cluster.run`.  ``chaos``
+    takes the same :class:`corrosion_tpu.chaos.LoweredChaos` as the JAX
+    backend: liveness / wipe / restart / partition come from the lowered
+    schedule tensors, and link drops consult the same
+    ``(schedule.seed, TAG_CHAOS_DROP, round, src, dst)`` draws, so the
+    two backends stay bit-identical under fault injection too."""
     N, K, T, D = p.n_nodes, p.n_changes, p.max_transmissions, p.churn_down_rounds
     max_rounds = p.max_rounds if max_rounds is None else max_rounds
     S = max(1, p.nseq_max)
@@ -136,6 +145,30 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
         1 if py_below(1_000_000, p.seed, TAG_PART, n) < p.partition_frac_ppm else 0
         for n in range(N)
     ]
+    c_drop = None
+    if chaos is not None:
+        chaos.require_sim_lowerable()
+        assert chaos.n_nodes == N, "chaos schedule sized for another cluster"
+        assert chaos.horizon >= max_rounds, "lower(sched, horizon=max_rounds)"
+        assert p.churn_ppm == 0 and p.partition_frac_ppm == 0, (
+            "explicit chaos schedules replace the ad-hoc churn/partition "
+            "scalars; zero them out (schedule.from_sim_params bridges)"
+        )
+        part = [int(x) for x in chaos.part_side]
+        c_drop = chaos.drop_ppm
+        c_seed = chaos.schedule.seed
+
+    def link_dropped(r: int, src: int, dst: int) -> bool:
+        """Same per-(round, src, dst) verdict the JAX step and the
+        runtime injector compute (one draw per link per round)."""
+        if c_drop is None:
+            return False
+        ppm = int(c_drop[r][src][dst])
+        return (
+            ppm > 0
+            and py_below(1_000_000, c_seed, TAG_CHAOS_DROP, r, src, dst) < ppm
+        )
+
     full = [int(m) for m in syncmod.full_masks(p)]
     aidx, vidx, n_actors = syncmod.actor_index(p)
 
@@ -161,9 +194,6 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
     since: List[List[int]] = [[0] * N, [0] * N]
     per_node = p.swim and p.swim_per_node_views
     if per_node:
-        assert p.partition_frac_ppm == 0, (
-            "per-node views do not model partitions yet"
-        )
         # view[v][t] / vsince[v][t]: viewer v's belief about member t
         view: List[List[int]] = [[ALIVE] * N for _ in range(N)]
         vsince: List[List[int]] = [[0] * N for _ in range(N)]
@@ -191,10 +221,17 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
 
     result = RefResult(converged=False, rounds=max_rounds)
     for r in range(max_rounds):
-        part_active = r < p.partition_rounds
+        if chaos is not None:
+            part_active = bool(chaos.part_active[r])
+            alive = [not chaos.dead[r][n] for n in range(N)]
+            restarted = [bool(chaos.restart[r][n]) for n in range(N)]
+        else:
+            part_active = r < p.partition_rounds
+            alive = [alive_at(r, n) for n in range(N)]
+            restarted = [
+                alive[n] and not alive_at(r - 1, n) for n in range(N)
+            ]
         pvec = part if part_active else [0] * N
-        alive = [alive_at(r, n) for n in range(N)]
-        restarted = [alive[n] and not alive_at(r - 1, n) for n in range(N)]
 
         # 1. inject
         for k in by_round.get(r, ()):  # noqa: B909 (read-only)
@@ -214,7 +251,9 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
                     v, lambda a, v=v: _probe_target(p, r, v, a), 0
                 )
                 if found:
-                    probes[v] = (t, alive[t])
+                    # a probe crossing an active partition cut fails like
+                    # a dead target would (mirrors cluster.py edge_ok)
+                    probes[v] = (t, alive[t] and pvec[v] == pvec[t])
             # stage A: suspicion expiry + own probe results, per viewer
             stA = [row[:] for row in view]
             sA = [row[:] for row in vsince]
@@ -265,9 +304,26 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
                     view[t][m] = ALIVE if alive[m] else DOWN
                     vsince[t][m] = r
                 view[t][t] = ALIVE
+                # the announce only crosses reachable links (no-op when
+                # no partition is active: pvec is all-zero then)
                 for v in range(N):
-                    if alive[v] and v != t:
+                    if alive[v] and v != t and pvec[v] == pvec[t]:
                         view[v][t], vsince[v][t] = ALIVE, r
+            # post-heal rejoin: a live viewer still holding a live node
+            # DOWN (cross-side suspicion expiry while partitioned) adopts
+            # its announce after the rejoin lag — the per-node mirror of
+            # the consensus branch's announce term (cluster.py rej)
+            for v in range(N):
+                if not alive[v]:
+                    continue
+                for m in range(N):
+                    if (
+                        alive[m]
+                        and view[v][m] == DOWN
+                        and r - vsince[v][m] >= p.swim_rejoin_rounds
+                        and pvec[v] == pvec[m]
+                    ):
+                        view[v][m], vsince[v][m] = ALIVE, r
         elif p.swim:
             succ_v = [set(), set()]
             fail_v = [set(), set()]
@@ -344,6 +400,7 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
                                 not found
                                 or pvec[n] != pvec[t]
                                 or not alive[t]
+                                or link_dropped(r, n, t)
                             ):
                                 continue
                             delivered[t][k] |= bit
@@ -358,7 +415,12 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
                             ),
                             part[n],
                         )
-                        if not found or pvec[n] != pvec[t] or not alive[t]:
+                        if (
+                            not found
+                            or pvec[n] != pvec[t]
+                            or not alive[t]
+                            or link_dropped(r, n, t)
+                        ):
                             continue
                         bit = 1 << s
                         for k in range(K):
@@ -388,6 +450,9 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
                     continue
                 if not (alive[n] and alive[q]):
                     continue
+                # the whole pull session rides the initiator→peer link
+                if link_dropped(r, n, q):
+                    continue
                 heads = syncmod.py_heads(snap[n], aidx, vidx, n_actors)
                 avail = syncmod.py_available(
                     snap[n], snap[q], full, heads, aidx, vidx
@@ -396,17 +461,23 @@ def run_reference(p: SimParams, max_rounds: Optional[int] = None) -> RefResult:
                 for k in range(K):
                     cov[n][k] |= pulled[k]
 
-        # 6. churn: deaths wipe to own writes; unresponsive for D rounds
-        if p.churn_ppm > 0 and p.churn_rounds > 0:
-            for n in range(N):
-                if death(r, n):
-                    for k in range(K):
-                        if origin[k] == n and inject_round[k] <= r:
-                            cov[n][k] = full[k]
-                            budget[n][k] = [T] * S
-                        else:
-                            cov[n][k] = 0
-                            budget[n][k] = [0] * S
+        # 6. churn: deaths wipe to own writes; unresponsive for D rounds.
+        # Hash-selected under the ad-hoc scalars, schedule-driven under
+        # an explicit chaos schedule
+        if chaos is not None:
+            dies = [n for n in range(N) if chaos.die[r][n]]
+        elif p.churn_ppm > 0 and p.churn_rounds > 0:
+            dies = [n for n in range(N) if death(r, n)]
+        else:
+            dies = []
+        for n in dies:
+            for k in range(K):
+                if origin[k] == n and inject_round[k] <= r:
+                    cov[n][k] = full[k]
+                    budget[n][k] = [T] * S
+                else:
+                    cov[n][k] = 0
+                    budget[n][k] = [0] * S
 
         # 7. convergence = every node holds every chunk of every changeset
         total = sum(

@@ -25,6 +25,14 @@ Stream tags (domain separation):
   TAG_PART    partition-side assignment for node
   TAG_TOPO    static topology neighbor table entry (node, slot)
   TAG_NSEQ    chunks-per-changeset draw for changeset k
+  TAG_CHAOS   chaos-schedule generation draws (chaos/schedule.py:
+              sub-stream 0 = partition side per node, 1 = crash draw
+              per (round, node))
+  TAG_CHAOS_DROP  per-(round, src, dst) link-drop decision, shared by
+              the sim lowering and the harness injector (chaos/)
+  TAG_CHAOS_DUP   per-(round, src, dst) link-duplicate decision
+              (runtime injector only; duplicates are OR-absorbed by
+              the sim's coverage masks)
 
 Draws that skip believed-down members append an ``attempt`` field for
 redraws — attempt 0 omits the field entirely, so runs where nothing is
@@ -52,6 +60,9 @@ TAG_PART = 7
 TAG_TOPO = 8
 # 9 is TAG_KEY in sim/crdt.py (CRDT register keys)
 TAG_NSEQ = 10  # chunks-per-changeset draw
+TAG_CHAOS = 11  # chaos schedule generation (chaos/schedule.py)
+TAG_CHAOS_DROP = 12  # per-(round, src, dst) link-drop decision (chaos/)
+TAG_CHAOS_DUP = 13  # per-(round, src, dst) link-duplicate decision (chaos/)
 
 
 def py_mix(x: int) -> int:

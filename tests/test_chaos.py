@@ -1,0 +1,378 @@
+"""The chaos subsystem's contract (doc/chaos.md): one schedule, two
+executors, deterministic everywhere.
+
+Layers under test, cheapest first:
+
+1. the schedule model — generation is a pure function of (seed,
+   GenParams), canonical JSON round-trips, validation rejects
+   malformed schedules;
+2. the lowering walk — crash/restart liveness windows bit-match the
+   simulator's churn semantics;
+3. subsumption — the ad-hoc ``churn_ppm`` / ``partition_frac_ppm``
+   scalars are degenerate cases: replaying them through
+   ``from_sim_params`` + ``lower`` reproduces the scalar run EXACTLY
+   (reference and JAX backends);
+4. cross-backend equality — JAX == scalar reference under a combined
+   partition + crash + drop schedule, in both membership-view models
+   (the per-node-view + partition combination this PR un-gated);
+5. the runtime injector + comparator — double harness runs of one
+   schedule produce byte-identical delivery-ledger and membership
+   digests; the ISSUE acceptance schedule (16 nodes, partition +
+   crash + drop, 48-round horizon) converges within ±2% gossip rounds
+   of the sim, with ``corro.chaos.injected.total{kind}`` /
+   ``corro.chaos.schedule.hash`` exported;
+6. the CLI — ``chaos gen`` is reproducible byte-for-byte and
+   ``chaos run --backend sim`` replays it.
+"""
+
+import asyncio
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.chaos import (
+    ChaosEvent,
+    ChaosSchedule,
+    GenParams,
+    from_sim_params,
+    generate,
+    lower,
+)
+from corrosion_tpu.chaos.schedule import CRASH, LINK, PARTITION
+from corrosion_tpu.sim.model import SimParams
+from corrosion_tpu.sim.reference import run_reference
+
+REPO = Path(__file__).resolve().parent.parent
+
+# the ISSUE acceptance schedule: >= 16 nodes, partition + crash + drop,
+# >= 12 rounds (seed 3 scanned for all three event kinds present)
+ACCEPT_GP = GenParams(
+    n_nodes=16, n_rounds=48, seed=3,
+    partition_frac_ppm=300_000, partition_rounds=6,
+    crash_ppm=40_000, crash_rounds=3, crash_down_rounds=3,
+    drop_ppm=50_000, drop_rounds=8,
+)
+
+
+# -- 1. schedule model ------------------------------------------------------
+
+
+def test_generate_pure_function_of_seed_and_params():
+    gp = GenParams(
+        n_nodes=16, n_rounds=32, seed=5,
+        partition_frac_ppm=300_000, partition_rounds=6,
+        crash_ppm=60_000, crash_rounds=3,
+    )
+    a, b = generate(gp), generate(gp)
+    assert a == b
+    assert a.schedule_hash() == b.schedule_hash()
+    # seed mutation -> different draws -> different schedule hash
+    c = generate(GenParams(**{**gp.__dict__, "seed": 6}))
+    assert c.schedule_hash() != a.schedule_hash()
+
+
+def test_json_roundtrip_preserves_hash():
+    s = generate(ACCEPT_GP)
+    rt = ChaosSchedule.from_json(s.to_json(indent=2))
+    assert rt.schedule_hash() == s.schedule_hash()
+    # the gauge encoding is the hash's low 48 bits: exact in a float64
+    assert float(int(rt.hash_gauge_value())) == rt.hash_gauge_value()
+
+
+def test_validate_rejects_malformed_schedules():
+    def sched(*events):
+        return ChaosSchedule(n_nodes=4, n_rounds=10, seed=0, events=events)
+
+    with pytest.raises(ValueError, match="proper subset"):
+        sched(ChaosEvent(round=0, kind=PARTITION, nodes=(0, 1, 2, 3))).validate()
+    with pytest.raises(ValueError, match="no partition"):
+        sched(ChaosEvent(round=2, kind="heal")).validate()
+    with pytest.raises(ValueError, match="not down"):
+        sched(ChaosEvent(round=1, kind="restart", nodes=(2,))).validate()
+    with pytest.raises(ValueError, match="until_round"):
+        sched(
+            ChaosEvent(round=3, kind=LINK, until_round=3, drop_ppm=10)
+        ).validate()
+    with pytest.raises(ValueError, match="out of range"):
+        sched(ChaosEvent(round=0, kind=CRASH, nodes=(7,))).validate()
+
+
+# -- 2. lowering ------------------------------------------------------------
+
+
+def test_lowering_liveness_walk_matches_churn_semantics():
+    """Crash at x with down_rounds=D: wiped at END of x (die), dead
+    x+1..x+D, replacement at x+D+1 — the sim's alive_at window."""
+    s = ChaosSchedule(
+        n_nodes=4, n_rounds=12, seed=0,
+        events=(ChaosEvent(round=2, kind=CRASH, nodes=(1,), down_rounds=3),),
+    )
+    lw = lower(s)
+    assert lw.die[2, 1] and lw.die.sum() == 1
+    assert [int(r) for r in np.where(lw.dead[:, 1])[0]] == [3, 4, 5]
+    assert lw.restart[6, 1] and lw.restart.sum() == 1
+
+
+def test_lowering_explicit_restart_and_never():
+    s = ChaosSchedule(
+        n_nodes=4, n_rounds=12, seed=0,
+        events=(
+            ChaosEvent(round=1, kind=CRASH, nodes=(2,), down_rounds=-1),
+            ChaosEvent(round=7, kind="restart", nodes=(2,)),
+        ),
+    )
+    lw = lower(s)
+    assert [int(r) for r in np.where(lw.dead[:, 2])[0]] == [2, 3, 4, 5, 6]
+    assert lw.restart[7, 2]
+
+
+def test_lowering_rejects_shifting_partition_sides():
+    s = ChaosSchedule(
+        n_nodes=4, n_rounds=12, seed=0,
+        events=(
+            ChaosEvent(round=0, kind=PARTITION, nodes=(0,)),
+            ChaosEvent(round=3, kind="heal"),
+            ChaosEvent(round=5, kind=PARTITION, nodes=(1,)),
+            ChaosEvent(round=8, kind="heal"),
+        ),
+    )
+    with pytest.raises(ValueError, match="static"):
+        lower(s)
+
+
+def test_runtime_only_faults_rejected_by_sim():
+    s = ChaosSchedule(
+        n_nodes=4, n_rounds=8, seed=0,
+        events=(
+            ChaosEvent(round=0, kind=LINK, until_round=4, delay_rounds=1),
+        ),
+    )
+    with pytest.raises(ValueError, match="delay"):
+        lower(s).require_sim_lowerable()
+
+
+# -- 3. subsumption: scalar churn/partition are degenerate schedules --------
+
+
+def _ref_state(res):
+    return (res.converged, res.rounds, res.cov, res.status, res.budget)
+
+
+def test_schedule_subsumes_churn_scalars_reference():
+    p = SimParams(
+        n_nodes=16, n_changes=8, fanout=3, max_transmissions=2,
+        sync_interval=3, write_rounds=1, max_rounds=32,
+        churn_ppm=90_000, churn_rounds=3, churn_down_rounds=3,
+        swim=True, swim_suspicion=True, fanout_per_change=True, seed=0,
+    )
+    lw = lower(from_sim_params(p), horizon=p.max_rounds)
+    assert lw.any_die()
+    clean = p.with_(churn_ppm=0)
+    assert _ref_state(run_reference(clean, chaos=lw)) == _ref_state(
+        run_reference(p)
+    )
+
+
+def test_schedule_subsumes_partition_scalars_reference():
+    p = SimParams(
+        n_nodes=16, n_changes=8, fanout=3, max_transmissions=2,
+        sync_interval=3, write_rounds=1, max_rounds=32,
+        partition_frac_ppm=300_000, partition_rounds=6,
+        swim=True, swim_suspicion=True, fanout_per_change=True, seed=1,
+    )
+    lw = lower(from_sim_params(p), horizon=p.max_rounds)
+    assert lw.any_partition()
+    clean = p.with_(partition_frac_ppm=0)
+    assert _ref_state(run_reference(clean, chaos=lw)) == _ref_state(
+        run_reference(p)
+    )
+
+
+def test_schedule_subsumes_churn_scalars_jax():
+    from corrosion_tpu.sim import cluster
+
+    p = SimParams(
+        n_nodes=16, n_changes=8, fanout=3, max_transmissions=2,
+        sync_interval=3, write_rounds=1, max_rounds=32,
+        churn_ppm=90_000, churn_rounds=3, churn_down_rounds=3,
+        swim=True, swim_suspicion=True, fanout_per_change=True, seed=0,
+    )
+    lw = lower(from_sim_params(p), horizon=p.max_rounds)
+    base = cluster.run(p, return_state=True)
+    got = cluster.run(p.with_(churn_ppm=0), chaos=lw, return_state=True)
+    assert got.rounds == base.rounds and got.converged == base.converged
+    for a, b in zip(got.state, base.state):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+# -- 4. JAX == reference under combined chaos -------------------------------
+
+
+def _combined_schedule(n_nodes=12, seed=0):
+    gp = GenParams(
+        n_nodes=n_nodes, n_rounds=24, seed=seed,
+        partition_frac_ppm=300_000, partition_rounds=5,
+        crash_ppm=60_000, crash_rounds=2, crash_down_rounds=3,
+        drop_ppm=80_000, drop_rounds=6,
+    )
+    s = generate(gp)
+    kinds = {e.kind for e in s.events}
+    assert {PARTITION, CRASH, LINK} <= kinds, f"seed draws {kinds}"
+    return s
+
+
+def _chaos_params(s, per_node):
+    return SimParams(
+        n_nodes=s.n_nodes, n_changes=8, fanout=3, max_transmissions=2,
+        sync_interval=3, write_rounds=1, max_rounds=s.n_rounds,
+        swim=True, swim_suspicion=True, swim_per_node_views=per_node,
+        fanout_per_change=True, seed=s.seed,
+    )
+
+
+@pytest.mark.parametrize("per_node", [False, True])
+def test_jax_matches_reference_under_combined_chaos(per_node):
+    from corrosion_tpu.sim import cluster
+
+    s = _combined_schedule()
+    p = _chaos_params(s, per_node)
+    lw = lower(s, horizon=p.max_rounds)
+    ref = run_reference(p, chaos=lw)
+    res = cluster.run(p, chaos=lw, return_state=True)
+    assert res.rounds == ref.rounds and res.converged == ref.converged
+    assert (np.asarray(res.state[0]) == np.asarray(ref.cov)).all()
+    assert (np.asarray(res.state[2]) == np.asarray(ref.status)).all()
+    assert (np.asarray(res.state[1]) == np.asarray(ref.budget)).all()
+
+
+def test_per_node_views_support_scalar_partition():
+    """The ``partition_frac_ppm == 0`` assertion under per-node views is
+    gone: the [N, N] view model runs partitioned configs and matches the
+    scalar reference exactly."""
+    from corrosion_tpu.sim import cluster
+
+    p = SimParams(
+        n_nodes=16, n_changes=8, fanout=3, max_transmissions=2,
+        sync_interval=3, write_rounds=1, max_rounds=32,
+        partition_frac_ppm=300_000, partition_rounds=6,
+        swim=True, swim_suspicion=True, swim_per_node_views=True,
+        fanout_per_change=True, seed=1,
+    )
+    ref = run_reference(p)
+    res = cluster.run(p, return_state=True)
+    assert res.converged and ref.converged
+    assert res.rounds == ref.rounds
+    assert (np.asarray(res.state[2]) == np.asarray(ref.status)).all()
+
+
+# -- 5. runtime injector + comparator ---------------------------------------
+
+
+def test_harness_replay_is_deterministic():
+    """ISSUE satellite: two harness runs of the same schedule produce
+    byte-identical delivery ledgers and membership timelines."""
+    from corrosion_tpu.chaos.compare import harness_run
+
+    gp = GenParams(
+        n_nodes=8, n_rounds=40, seed=1,
+        partition_frac_ppm=300_000, partition_rounds=5,
+        crash_ppm=60_000, crash_rounds=2, crash_down_rounds=3,
+        drop_ppm=100_000, drop_rounds=6,
+    )
+    s = generate(gp)
+    a = asyncio.run(harness_run(s))
+    b = asyncio.run(harness_run(s))
+    assert a.rounds is not None and a.rounds == b.rounds
+    assert a.ledger_digest == b.ledger_digest
+    assert a.membership_digest == b.membership_digest
+
+
+def test_chaos_compare_acceptance():
+    """The acceptance schedule replayed on both executors via the
+    comparator: within ±2% gossip rounds, with the injection counters
+    and schedule-hash gauge exported."""
+    from corrosion_tpu.chaos.compare import compare
+    from corrosion_tpu.utils.metrics import (
+        counter,
+        gauge,
+        render_prometheus,
+    )
+
+    s = generate(ACCEPT_GP)
+    kinds = {e.kind for e in s.events}
+    assert {PARTITION, CRASH, LINK} <= kinds
+    assert s.n_nodes >= 16 and s.n_rounds >= 12
+    res = asyncio.run(compare(s))
+    assert res.harness_rounds is not None, "harness leg did not converge"
+    assert res.sim_rounds is not None, "sim leg did not converge"
+    assert res.gap is not None and res.gap <= 0.02, (
+        f"chaos fidelity broken: harness={res.harness_rounds} vs "
+        f"sim={res.sim_rounds} — gap {res.gap*100:.2f}% > ±2%"
+    )
+    # telemetry contract (doc/telemetry.md): injected events counted by
+    # kind, schedule identity on the gauge
+    assert counter("corro.chaos.injected.total", kind="drop").value > 0
+    assert counter("corro.chaos.injected.total", kind="crash").value > 0
+    assert counter("corro.chaos.injected.total", kind="partition").value > 0
+    assert gauge("corro.chaos.schedule.hash").value == float(
+        s.hash_gauge_value()
+    )
+    text = render_prometheus()
+    assert "corro_chaos_injected_total{kind=" in text
+    assert "corro_chaos_schedule_hash" in text
+
+
+def test_compare_rejects_sim_only_and_never_reviving_schedules():
+    from corrosion_tpu.chaos.compare import check_harness_runnable
+
+    wipe_only = ChaosSchedule(
+        n_nodes=4, n_rounds=10, seed=0,
+        events=(ChaosEvent(round=1, kind=CRASH, nodes=(0,), down_rounds=0),),
+    )
+    with pytest.raises(ValueError, match="wipe-only"):
+        check_harness_runnable(wipe_only)
+    forever = ChaosSchedule(
+        n_nodes=4, n_rounds=10, seed=0,
+        events=(ChaosEvent(round=1, kind=CRASH, nodes=(0,), down_rounds=-1),),
+    )
+    with pytest.raises(ValueError, match="no later restart"):
+        check_harness_runnable(forever)
+
+
+# -- 6. CLI -----------------------------------------------------------------
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "corrosion_tpu.cli", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin:/usr/local/bin"},
+        timeout=240,
+    )
+
+
+def test_cli_chaos_gen_reproducible_and_runnable(tmp_path):
+    gen_args = [
+        "chaos", "gen", "--nodes", "16", "--rounds", "24", "--seed", "7",
+        "--partition-ppm", "300000", "--partition-rounds", "5",
+        "--drop-ppm", "50000", "--drop-rounds", "6",
+    ]
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    ra = _cli(*gen_args, "-o", str(a))
+    rb = _cli(*gen_args, "-o", str(b))
+    assert ra.returncode == 0 and rb.returncode == 0, ra.stderr + rb.stderr
+    assert a.read_bytes() == b.read_bytes()
+    run = _cli("chaos", "run", str(a), "--backend", "sim")
+    assert run.returncode == 0, run.stderr
+    out = json.loads(run.stdout)
+    assert out["backend"] == "sim"
+    assert out["schedule_hash"] == ChaosSchedule.from_json(
+        a.read_text()
+    ).schedule_hash()
+    assert out["rounds"] is not None

@@ -48,7 +48,11 @@ import statistics
 import time
 
 from corrosion_tpu.agent.agent import make_broadcastable_changes
-from corrosion_tpu.harness import DevCluster, Topology
+from corrosion_tpu.chaos.pairing import (
+    converged as _converged,
+    star_topology,
+)
+from corrosion_tpu.harness import DevCluster
 from corrosion_tpu.sim.model import ER, POWERLAW, SimParams
 from corrosion_tpu.sim.reference import run_reference
 
@@ -63,15 +67,6 @@ TOLERANCE = 0.02
 _ids = itertools.count(1)
 
 
-def star_topology(n):
-    topo = Topology()
-    names = [f"n{i:02d}" for i in range(n)]
-    topo.edges[names[0]] = []
-    for name in names[1:]:
-        topo.add_edge(name, names[0])
-    return topo, names
-
-
 async def wait_membership(nodes, timeout=60.0):
     deadline = time.monotonic() + timeout
     while True:
@@ -81,17 +76,6 @@ async def wait_membership(nodes, timeout=60.0):
             counts = [len(n.members.up_members()) for n in nodes]
             raise TimeoutError(f"membership incomplete: {counts}")
         await asyncio.sleep(0.1)
-
-
-def _converged(nodes, expected_heads):
-    """The stress-test convergence bar: nothing needed anywhere AND every
-    node's per-actor heads equal the global write counts
-    (ref: tests.rs:464-476 all-rows + need_len()==0)."""
-    for node in nodes:
-        st = node.agent.generate_sync()
-        if st.need_len() != 0 or st.heads != expected_heads:
-            return False
-    return True
 
 
 async def one_trial(
@@ -328,114 +312,18 @@ def test_round_counts_chunked_payloads():
 # interop-tested (tests/test_swim_native.py); the round-model fidelity
 # being measured is impl-independent.
 
-from corrosion_tpu.sim.rng import (  # noqa: E402
-    TAG_CHURN,
-    TAG_ORIGIN,
-    TAG_SYNC,
-    py_below,
+# the paired-draw machinery was developed in this file and now lives in
+# corrosion_tpu.chaos.pairing, where the chaos comparator drives the same
+# helpers from explicit fault schedules (doc/chaos.md)
+from corrosion_tpu.chaos.pairing import (  # noqa: E402
+    PROBE_TIMEOUT,
+    SUSPICION_ROUNDS,
+    arm_node as _arm,
+    install_fanout_pairing,
+    paired_sync_draw,
+    sim_death_schedule,
+    sim_origins,
 )
-
-
-def paired_sync_draw(p: SimParams):
-    """The sim's exact TAG_SYNC peer draw (reference._sync_peer), handed
-    to step_round so harness and sim sync with the SAME peers per
-    (round, node) — pairing away the draw luck that dominates the means
-    (e.g. whether a fresh replacement pulls from another empty
-    replacement or from a converged node)."""
-
-    def draw(r: int, me: int, a: int) -> int:
-        suffix = () if a == 0 else (a,)
-        q = py_below(p.n_nodes - 1, p.seed, TAG_SYNC, r, me, *suffix)
-        return q + 1 if q >= me else q
-
-    return draw
-
-
-from corrosion_tpu.sim.reference import (  # noqa: E402
-    _bcast_target as _ref_bcast_target,
-)
-from corrosion_tpu import wire as _wire  # noqa: E402
-
-
-def install_fanout_pairing(cluster, names, p: SimParams, key_to_k, node, me):
-    """Install the sim's exact TAG_BCAST fanout draw on one node's
-    broadcast runtime (reference._bcast_target + draw_excluding, the
-    fanout_per_change policy): each pending payload — mapped back to its
-    sim changeset index via (actor, versions) — fans out to the SAME
-    per-(round, node, slot) hash-drawn targets as the sim, with the same
-    distinct-target exclusion chain and believed-down redraws.  Pairs
-    away the last unpaired randomness in the failure-mode experiments."""
-    assert p.nseq_max <= 1, "fanout pairing supports single-chunk payloads"
-    S = max(1, p.nseq_max)
-    attempts = p.swim_probe_attempts if p.swim else 1  # ref: reference.py
-    addr_of = [("127.0.0.1", cluster._ports[nm]) for nm in names]
-
-    def hook(payload):
-        try:
-            _kind, data = _wire.decode_uni(payload)
-        except _wire.WireError:
-            return None
-        change = data[0]
-        k = key_to_k.get((bytes(change.actor_id), change.changeset.versions))
-        if k is None:
-            return None
-        r = cluster.vround
-        ups = {(m.addr[0], m.addr[1]) for m in node.members.up_members()}
-        out, chosen = [], []
-        for j in range(p.fanout):
-            slot = j * S  # single-chunk payloads: s = 0
-            t_found = first = None
-            for a in range(attempts):
-                # the sim's own draw function IS the pairing source —
-                # any topology it supports pairs for free, and a keying
-                # change can never drift between the two
-                u = _ref_bcast_target(p, r, me, slot, k, a, chosen)
-                if first is None:
-                    first = u
-                if addr_of[u] in ups:
-                    t_found = u
-                    break
-            # mirror reference.draw_excluding: the FIRST candidate joins
-            # the exclusion chain even when every attempt was believed
-            # down (keeps later slots' draws bit-identical to the sim)
-            chosen.append(t_found if t_found is not None else first)
-            if t_found is not None:
-                out.append(addr_of[t_found])
-        return out
-
-    node.broadcast.draw_hook = hook
-
-SUSPICION_ROUNDS = 3
-PROBE_TIMEOUT = 0.3
-
-
-def sim_death_schedule(p: SimParams):
-    """{round: [node, ...]} — the sim's exact churn draws for this seed."""
-    return {
-        x: [
-            n
-            for n in range(p.n_nodes)
-            if py_below(1_000_000, p.seed, TAG_CHURN, x, n) < p.churn_ppm
-        ]
-        for x in range(p.churn_rounds)
-    }
-
-
-def sim_origins(p: SimParams):
-    return [py_below(p.n_nodes, p.seed, TAG_ORIGIN, k) for k in range(p.n_changes)]
-
-
-def _arm(node, trial_seed, i, next_probe_at=0.0):
-    """Per-trial determinism: freeze RTT rings (loopback would put every
-    member in ring0 → broadcast-to-all) and seed the broadcast + SWIM
-    rngs."""
-    node.transport.on_rtt = None
-    for m in node.members.states.values():
-        m.ring = None
-        m.rtts.clear()
-    node.broadcast.rng = random.Random((trial_seed + 1) * 1000 + i)
-    node.swim.rng = random.Random((trial_seed + 1) * 77_000 + i)
-    node.swim._next_probe_at = next_probe_at
 
 
 async def one_churn_trial(p: SimParams, names):
@@ -644,15 +532,7 @@ def test_round_counts_churn_at_scale():
 # and write origins (TAG_ORIGIN) replay the sim's exact hash draws per
 # seed, so the means differ only by the dynamics under test.
 
-from corrosion_tpu.sim.rng import TAG_PART  # noqa: E402
-
-
-def sim_partition_sides(p: SimParams):
-    return [
-        1 if py_below(1_000_000, p.seed, TAG_PART, n) < p.partition_frac_ppm
-        else 0
-        for n in range(p.n_nodes)
-    ]
+from corrosion_tpu.chaos.pairing import sim_partition_sides  # noqa: E402
 
 
 async def one_partition_trial(p: SimParams, names):

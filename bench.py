@@ -43,10 +43,12 @@ def _cache_entries(cache_dir: str) -> int:
         return 0
 
 
-def run_config(n: int, seed: int, scale: float, dev, cache_dir: str) -> dict:
-    from corrosion_tpu.sim import cluster, crdt, model, reference
+def run_config(
+    n: int, seed: int, scale: float, dev, cache_dir: str, packed: bool = True
+) -> dict:
+    from corrosion_tpu.sim import cluster, crdt, model, profile, reference
 
-    p = model.CONFIGS[n](seed=seed)
+    p = model.CONFIGS[n](seed=seed).with_(packed=packed)
     if scale != 1.0:
         p = p.with_(n_nodes=max(8, int(p.n_nodes * scale)))
     log(f"config {n}: {p}")
@@ -95,8 +97,14 @@ def run_config(n: int, seed: int, scale: float, dev, cache_dir: str) -> dict:
     # which the runtime never does (it applies only complete versions,
     # agent/apply.py); matters whenever nseq_max > 1 (config 3).
     t0 = time.perf_counter()
-    have = cluster.complete_mask(res.state[0], p)
-    reg, cl = crdt.merge_registers(have, p, n_keys=64)
+    if p.packed:
+        # stay in word space: lane-LSB complete flags, rows unpacked
+        # transiently inside the merge vmap (no [N, K] boolean at 1M)
+        have = cluster.complete_flags_packed(res.state[0], p)
+        reg, cl = crdt.merge_registers(have, p, n_keys=64, packed=True)
+    else:
+        have = cluster.complete_mask(res.state[0], p)
+        reg, cl = crdt.merge_registers(have, p, n_keys=64)
     reg_ok = bool((reg == reg[0]).all()) and bool((cl == cl[0]).all())
     crdt_s = time.perf_counter() - t0
     log(f"crdt merge agreement across nodes: {reg_ok} ({crdt_s:.2f}s)")
@@ -113,8 +121,18 @@ def run_config(n: int, seed: int, scale: float, dev, cache_dir: str) -> dict:
         f"(execute={warm.wall_s:.2f}s cache-load={warm.compile_s:.2f}s)"
     )
 
+    # roofline numbers for one warm round: bytes moved, achieved vs peak
+    # bandwidth (sim/profile.py; BENCHMARKS.md's roofline section is
+    # generated from these fields — never hand-edited)
+    prof = profile.profile_round(p, reps=2, device=dev)
+    log(
+        f"profile: {prof.round_s * 1e3:.1f} ms/round, "
+        f"{(prof.xla_bytes_per_round or prof.floor_bytes_per_round) / 1e6:.0f} MB/round, "
+        f"{prof.hbm_utilization * 100:.0f}% of peak ({prof.peak_basis})"
+    )
+
     total = res.compile_s + res.wall_s
-    return {
+    out = {
         "metric": f"sim_{p.n_nodes}n_config{n}_convergence_wall",
         "value": round(total, 3),
         "unit": "s",
@@ -128,6 +146,8 @@ def run_config(n: int, seed: int, scale: float, dev, cache_dir: str) -> dict:
         "cache": cache_state,
         "device": dev.platform,
     }
+    out.update(profile.bench_fields(prof))
+    return out
 
 
 def main() -> None:
@@ -141,6 +161,12 @@ def main() -> None:
     )
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--unpacked",
+        action="store_true",
+        help="run with the legacy uint8/int8 state planes (default: packed "
+        "uint32 words, sim/pack.py)",
+    )
     args = ap.parse_args()
 
     t_all = time.perf_counter()
@@ -161,11 +187,38 @@ def main() -> None:
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
 
+    packed = not args.unpacked
+
     # the full BASELINE config set; headline config 4 goes LAST so
     # last-line JSON parsers record it
     configs = [args.config] if args.config is not None else [1, 2, 3, 5, 4]
     for n in configs:
-        out = run_config(n, args.seed, args.scale, dev, cache_dir)
+        # 1M-node headroom line: config 4 at 10× node count, run just
+        # before the headline when the device can actually hold one round
+        # (live state + transient planes, profile.peak_round_bytes_estimate)
+        # — skipped, with the reason logged, on CPU hosts and small parts.
+        if n == 4 and args.config is None and args.scale == 1.0:
+            from corrosion_tpu.sim import model, profile
+
+            p1m = model.CONFIGS[4](seed=args.seed).with_(packed=packed)
+            p1m = p1m.with_(n_nodes=p1m.n_nodes * 10)
+            need = profile.peak_round_bytes_estimate(p1m)
+            try:
+                limit = dev.memory_stats().get("bytes_limit", 0)
+            except Exception:
+                limit = 0
+            if dev.platform != "cpu" and limit >= 1.5 * need:
+                out = run_config(
+                    4, args.seed, 10.0, dev, cache_dir, packed=packed
+                )
+                print(json.dumps(out), flush=True)
+            else:
+                log(
+                    f"1M headroom run skipped: need ~{1.5 * need / 1e9:.1f} GB "
+                    f"device memory (have "
+                    f"{limit / 1e9:.1f} GB on {dev.platform})"
+                )
+        out = run_config(n, args.seed, args.scale, dev, cache_dir, packed=packed)
         print(json.dumps(out), flush=True)
     log(f"total harness wall (incl. imports): {time.perf_counter()-t_all:.2f}s")
 

@@ -11,9 +11,11 @@ fanout/sync as scatter-max/gather, sharded over a device mesh.
 - cluster:   vectorized JAX simulator (the TPU compute path)
 - sync:      anti-entropy needs algebra as coverage-bitmask operations
 - crdt:      vectorized LWW/causal-length merge analysis
+- pack:      uint32 bitpacked state-plane layout + lane algebra
+- profile:   roofline instrumentation (bytes/round, HBM utilization)
 """
 
 from .model import CONFIGS, SimParams  # noqa: F401
 from .cluster import SimResult, init_state, make_step, run, run_trace  # noqa: F401
 from .reference import RefResult, run_reference  # noqa: F401
-from . import sync  # noqa: F401
+from . import pack, sync  # noqa: F401

@@ -26,8 +26,21 @@ reference (sim/reference.py), so round counts agree exactly.
 Scaling: shard the node axis across a ``jax.sharding.Mesh`` —
 ``run(p, mesh=...)`` places state with ``NamedSharding(P('nodes', None))``
 and jits the full loop; GSPMD turns the cross-shard scatters/gathers into
-ICI collectives.  No data-dependent Python control flow: convergence is the
-``while_loop`` predicate, computed on-device.
+ICI collectives (``change_axis`` adds the second mesh dimension over the
+changeset/word axis).  No data-dependent Python control flow: convergence
+is the ``while_loop`` predicate, computed on-device.
+
+Memory: with ``p.packed`` the two dominant planes ride the loop as uint32
+words (sim/pack.py — up to 32 changesets per cov word, 16 budget counters
+per word), and the round transition keeps the word algebra end to end:
+inject is a disjoint-lane scatter-add, receive/churn are carry-free
+shift/mask arithmetic, the anti-entropy needs rule runs on words
+(sync.jx_available_packed) and convergence is a packed-word compare with
+popcount completions.  Only the broadcast scatter planes stay per-chunk
+boolean [N, K] (a scatter-max over multi-bit words is NOT a bitwise OR —
+lanes from different payloads would drop bits), and those are transient,
+not live state.  3-5× less HBM per round; trajectories bit-identical
+(tests/test_sim_pack.py).  sim/profile.py measures the bytes.
 
 Fidelity contract with the scalar mirror is enforced by tests/test_sim.py
 (exact round-count and state equality on all five BASELINE configs, small
@@ -60,9 +73,11 @@ from .rng import (
     TAG_TOPO,
     jx_below,
 )
+from . import pack
 from . import sync as syncmod
 
-# (cov, budget, status, since, round)
+# (cov, budget, status, since, round); packed runs carry cov/budget as
+# uint32[N, Wc] / uint32[N, Wb] word planes (sim/pack.py layout)
 SimState = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
 
 
@@ -90,6 +105,16 @@ def _consts(p: SimParams):
 
 def init_state(p: SimParams) -> SimState:
     S = max(1, p.nseq_max)
+    if p.packed:
+        # uint32 word planes (sim/pack.py): up to 32 changesets per cov
+        # word, 16 budget counters per word — the 3-5× live-state cut
+        # that buys 1M→4M single-chip headroom (sim/profile.py)
+        cov = jnp.zeros((p.n_nodes, pack.cov_words(p)), dtype=jnp.uint32)
+        budget = jnp.zeros((p.n_nodes, pack.budget_words(p)), dtype=jnp.uint32)
+        n_views = p.n_nodes if (p.swim and p.swim_per_node_views) else 2
+        status = jnp.full((n_views, p.n_nodes), ALIVE, dtype=jnp.int8)
+        since = jnp.zeros((n_views, p.n_nodes), dtype=jnp.int32)
+        return cov, budget, status, since, jnp.int32(0)
     cov = jnp.zeros((p.n_nodes, p.n_changes), dtype=jnp.uint8)
     # per-CHUNK retransmission budgets: the runtime re-sends each pending
     # payload (= one chunk) on its own send_count (broadcast/mod.rs:
@@ -105,9 +130,23 @@ def init_state(p: SimParams) -> SimState:
 
 
 def complete_mask(state_cov: jnp.ndarray, p: SimParams) -> jnp.ndarray:
-    """bool[N, K]: which changesets are fully assembled at each node."""
+    """bool[N, K]: which changesets are fully assembled at each node.
+    Accepts the packed uint32[N, Wc] plane when ``p.packed``."""
+    if p.packed:
+        state_cov = pack.unpack_cov(state_cov, p)
     full = jnp.asarray(syncmod.full_masks(p))
     return state_cov == full[None, :]
+
+
+def complete_flags_packed(cov_words: jnp.ndarray, p: SimParams) -> jnp.ndarray:
+    """uint32[N, Wc] lane-LSB flags: changeset complete ⇔ its lane of
+    ``cov XOR full`` is all-zero; padding lanes masked clear.  The packed
+    twin of :func:`complete_mask` — stays in word space so the 1M-node
+    CRDT merge never materializes a [N, K] boolean (sim/crdt.py consumes
+    these flags row-wise under vmap)."""
+    full_w = jnp.asarray(pack.full_masks_packed(p))
+    not_complete = pack.lane_nonzero(cov_words ^ full_w[None, :], pack.lane_bits(p))
+    return jnp.asarray(pack.valid_lane_mask(p))[None, :] & ~not_complete
 
 
 def make_step(p: SimParams, chaos=None):
@@ -155,6 +194,22 @@ def make_step(p: SimParams, chaos=None):
     full = jnp.asarray(syncmod.full_masks(p))
     aidx, vidx, n_actors = syncmod.actor_index(p)
     attempts = p.swim_probe_attempts if p.swim else 1
+    if p.packed:
+        # packed-layout constants (eager, folded into the executable):
+        # lane widths, packed full masks, and the word-index / lane-shift
+        # maps for the inject scatters — per changeset (cov layout) and
+        # per (changeset, chunk) (budget layout)
+        cb, bb = pack.lane_bits(p), pack.budget_lane_bits(p)
+        full_w = jnp.asarray(pack.full_masks_packed(p))
+        full32 = full.astype(jnp.uint32)
+        kword = karange // pack.lanes_per_word(p)
+        kshift = (karange % pack.lanes_per_word(p)).astype(jnp.uint32) * jnp.uint32(cb)
+        ks = jnp.arange(K * S, dtype=jnp.int32)
+        lanes_b = pack.budget_lanes_per_word(p)
+        ks_word = ks // lanes_b
+        ks_shift = (ks % lanes_b).astype(jnp.uint32) * jnp.uint32(bb)
+        ks_k = ks // S
+        T32 = jnp.uint32(p.max_transmissions)
 
     def death(x):
         """bool[N]: churn death draw hit at round x (x may be negative)."""
@@ -179,17 +234,20 @@ def make_step(p: SimParams, chaos=None):
         draw shape ([N] for per-node draws, [N, 1] for per-change [N, K]
         draws).  Returns (target, found); target is the first candidate
         when nothing was found (mirrored by reference.draw_excluding so
-        the exclusion chains below stay bit-identical)."""
-        t = draw_fn(0)
-        ok = jnp.logical_not(down2[view_b, t])
-        for a in range(1, attempts):
-            cand = draw_fn(a)
-            take = jnp.logical_and(
-                jnp.logical_not(ok), jnp.logical_not(down2[view_b, cand])
-            )
-            t = jnp.where(take, cand, t)
-            ok = jnp.logical_or(ok, take)
-        return t, ok
+        the exclusion chains below stay bit-identical).
+
+        Fused: the ``attempts`` candidates are one stacked
+        [attempts, ...] plane resolved by a SINGLE batched membership
+        gather + argmax select, instead of one draw + gather per attempt
+        — the round kernel issues O(1) gathers per mechanism regardless
+        of swim_probe_attempts.  argmax over booleans returns the FIRST
+        True (and index 0 when none is), exactly the sequential
+        first-acceptable-else-first-candidate rule."""
+        cands = jnp.stack([draw_fn(a) for a in range(attempts)])
+        ok = jnp.logical_not(down2[view_b[None], cands])
+        first = jnp.argmax(ok, axis=0)
+        t = jnp.take_along_axis(cands, first[None], axis=0)[0]
+        return t, ok.any(axis=0)
 
     nvec = narange[:, None]  # [N, 1]
     kvec = karange[None, :]  # [1, K]
@@ -302,12 +360,26 @@ def make_step(p: SimParams, chaos=None):
 
         # 1. inject this round's writes at their origins, full coverage
         inj = inject_round == r
-        cov = cov.at[origin, karange].max(
-            jnp.where(inj, full[karange], jnp.uint8(0))
-        )
-        budget = budget.at[origin, karange, :].max(
-            jnp.where(inj, T8, jnp.int8(0))[:, None]
-        )
+        if p.packed:
+            # disjoint-lane scatter-ADD == scatter-OR here: colliding
+            # (row, word) entries are distinct changesets → distinct
+            # lanes, and a changeset's lane is provably zero before its
+            # inject round (nothing can deliver or sync-pull chunks of an
+            # uninjected changeset, and churn wipes only restore already-
+            # injected own writes)
+            cov = cov.at[origin, kword].add(
+                jnp.where(inj, full32 << kshift, jnp.uint32(0))
+            )
+            budget = budget.at[origin[ks_k], ks_word].add(
+                jnp.where(inj[ks_k], T32 << ks_shift, jnp.uint32(0))
+            )
+        else:
+            cov = cov.at[origin, karange].max(
+                jnp.where(inj, full[karange], jnp.uint8(0))
+            )
+            budget = budget.at[origin, karange, :].max(
+                jnp.where(inj, T8, jnp.int8(0))[:, None]
+            )
 
         # 2. SWIM probe / suspect / refute / rejoin
         if p.swim:
@@ -481,13 +553,25 @@ def make_step(p: SimParams, chaos=None):
         # bit (a max over mixed bit values would drop bits — OR semantics
         # needed); targets are [N, K] so the scatter is elementwise
         # (t[n, k], k) ← pay[n, k]
-        pend = jnp.logical_and(budget > 0, alive[:, None, None])  # [N,K,S]
-        delivered = jnp.zeros_like(cov)
+        if p.packed:
+            # pend/hold bits come straight off the word planes via lane
+            # shift algebra; only the scatter planes and their uint8
+            # accumulator are per-changeset, and they are transients
+            # fused into the scatter — not live state
+            pend_lsb = pack.lane_nonzero(budget, bb)  # [N, Wb] LSB flags
+            pend = jnp.logical_and(
+                pack.unpack_budget(pend_lsb, p) != 0, alive[:, None, None]
+            )
+            covu = pack.unpack_cov(cov, p)  # transient lane values
+        else:
+            pend = jnp.logical_and(budget > 0, alive[:, None, None])
+            covu = cov
+        delivered = jnp.zeros((N, K), dtype=jnp.uint8)
         kk = jnp.broadcast_to(kvec, (N, K))
         for s in range(S):
             bit = jnp.uint8(1 << s)
             plane = jnp.zeros((N, K), dtype=bool)
-            hold = jnp.logical_and(pend[:, :, s], (cov & bit).astype(bool))
+            hold = jnp.logical_and(pend[:, :, s], (covu & bit).astype(bool))
             if p.fanout_per_change:
                 chosen = []
                 for j in range(p.fanout):
@@ -526,20 +610,34 @@ def make_step(p: SimParams, chaos=None):
         # 4. receive: accumulate chunks; a newly received chunk refreshes
         # ITS OWN budget only (one pending payload per chunk, like the
         # runtime); every pending chunk that sent this round decrements
-        new_bits = delivered & ~cov
-        new_bits = jnp.where(alive[:, None], new_bits, 0)
-        cov = cov | new_bits
-        chunk_bits = jnp.asarray(
-            [1 << s for s in range(S)], dtype=jnp.uint8
-        )
-        new_per_chunk = (
-            new_bits[:, :, None] & chunk_bits[None, None, :]
-        ) != 0
-        budget = jnp.where(
-            new_per_chunk,
-            T8,
-            jnp.where(pend, budget - jnp.int8(1), budget),
-        )
+        if p.packed:
+            delivered_w = pack.pack_cov(delivered, p)
+            new_w = delivered_w & ~cov
+            new_w = jnp.where(alive[:, None], new_w, jnp.uint32(0))
+            cov = cov | new_w
+            # budget-layout lane-LSB flags of the newly landed chunks
+            new_f = pack.cov_words_to_chunk_flags(new_w, p)
+            pend_f = jnp.where(alive[:, None], pend_lsb, jnp.uint32(0))
+            # decrement pending lanes that sent — each such lane is ≥ 1,
+            # so no borrow crosses a lane boundary — then clear + refresh
+            # the newly-received lanes to max_transmissions
+            budget = budget - (pend_f & ~new_f)
+            budget = (budget & ~pack.lane_fill(new_f, bb)) | new_f * T32
+        else:
+            new_bits = delivered & ~cov
+            new_bits = jnp.where(alive[:, None], new_bits, 0)
+            cov = cov | new_bits
+            chunk_bits = jnp.asarray(
+                [1 << s for s in range(S)], dtype=jnp.uint8
+            )
+            new_per_chunk = (
+                new_bits[:, :, None] & chunk_bits[None, None, :]
+            ) != 0
+            budget = jnp.where(
+                new_per_chunk,
+                T8,
+                jnp.where(pend, budget - jnp.int8(1), budget),
+            )
 
         # 5. anti-entropy: budgeted needs-based pull from one peer
         if p.sync_interval > 0:
@@ -557,13 +655,47 @@ def make_step(p: SimParams, chaos=None):
             if c_drop is not None:
                 # the whole pull session rides the initiator→peer link
                 okq = jnp.logical_and(okq, link_up(narange, q))
-            heads_mine = syncmod.jx_heads(cov, aidx, vidx, n_actors)
-            avail = syncmod.jx_available(
-                cov, cov[q], full, heads_mine, aidx, vidx
-            )
-            pulled = syncmod.jx_budget_transfer(avail, p.sync_chunk_budget)
-            do = jnp.logical_and((r + 1) % p.sync_interval == 0, okq)
-            cov = jnp.where(do[:, None], cov | pulled, cov)
+
+            def sync_pull(c):
+                """Needs algebra + pull on whichever cov layout rides the
+                carry.  Runs under ``lax.cond``, so the off rounds skip
+                the [N]-row gather and the needs arithmetic entirely
+                instead of computing-then-masking them (sync_interval−1
+                of every sync_interval rounds); the counter-based RNG
+                consumes no state, so skipping draws is trajectory-free.
+                """
+                if p.packed:
+                    # heads need per-changeset "any coverage" flags only:
+                    # lane-fold to LSBs, unpack 0/1 (transient)
+                    seen = pack.unpack_cov(pack.lane_nonzero(c, cb), p)
+                    heads_mine = syncmod.jx_heads(seen, aidx, vidx, n_actors)
+                    avail = syncmod.jx_available_packed(
+                        c, c[q], full_w, heads_mine, aidx, vidx, p
+                    )
+                    if p.sync_chunk_budget > 0:
+                        # the (version, seq)-ordered cumsum cap wants
+                        # per-changeset masks; transient unpack/repack
+                        pulled = pack.pack_cov(
+                            syncmod.jx_budget_transfer(
+                                pack.unpack_cov(avail, p),
+                                p.sync_chunk_budget,
+                            ),
+                            p,
+                        )
+                    else:
+                        pulled = avail
+                else:
+                    heads_mine = syncmod.jx_heads(c, aidx, vidx, n_actors)
+                    avail = syncmod.jx_available(
+                        c, c[q], full, heads_mine, aidx, vidx
+                    )
+                    pulled = syncmod.jx_budget_transfer(
+                        avail, p.sync_chunk_budget
+                    )
+                return jnp.where(okq[:, None], c | pulled, c)
+
+            due = (r + 1) % p.sync_interval == 0
+            cov = lax.cond(due, sync_pull, lambda c: c, cov)
 
         # 6. churn: deaths wipe to own writes (replacement node
         # re-registering); the node stays unresponsive for D rounds.
@@ -582,21 +714,40 @@ def make_step(p: SimParams, chaos=None):
             # constant in the executable
             own = origin[None, :] == narange[:, None]
             own_now = jnp.logical_and(own, inject_round[None, :] <= r)
-            own_cov = jnp.where(own_now, full[None, :], 0).astype(jnp.uint8)
-            cov = jnp.where(die[:, None], own_cov, cov)
-            budget = jnp.where(
-                die[:, None, None],
-                jnp.where(own_now[:, :, None], T8, jnp.int8(0)),
-                budget,
-            )
+            if p.packed:
+                own_cov = pack.pack_cov(
+                    jnp.where(own_now, full[None, :], jnp.uint8(0)), p
+                )
+                cov = jnp.where(die[:, None], own_cov, cov)
+                own_f = pack.pack_chunk_flags(
+                    jnp.broadcast_to(own_now[:, :, None], (N, K, S)), p
+                )
+                budget = jnp.where(die[:, None], own_f * T32, budget)
+            else:
+                own_cov = jnp.where(own_now, full[None, :], 0).astype(jnp.uint8)
+                cov = jnp.where(die[:, None], own_cov, cov)
+                budget = jnp.where(
+                    die[:, None, None],
+                    jnp.where(own_now[:, :, None], T8, jnp.int8(0)),
+                    budget,
+                )
         return cov, budget, status, since, r + 1
 
     return step
 
 
+def _full_plane(p: SimParams) -> jnp.ndarray:
+    """The all-complete cov plane: [K] uint8, or [Wc] uint32 when packed
+    (padding lanes are zero on both sides of the compare, so whole-word
+    equality is exactly per-changeset completeness)."""
+    if p.packed:
+        return jnp.asarray(pack.full_masks_packed(p))
+    return jnp.asarray(syncmod.full_masks(p))
+
+
 def _run_loop(p: SimParams, state: SimState, chaos=None) -> SimState:
     step = make_step(p, chaos=chaos)
-    full = jnp.asarray(syncmod.full_masks(p))
+    full = _full_plane(p)
 
     def cond(state):
         cov = state[0]
@@ -622,7 +773,14 @@ def state_shardings(
     (node_axis, change_axis, None), [N, K] arrays shard
     (node_axis, change_axis), [N] arrays shard (node_axis,), anything
     else — the [2, N] membership views, the scalar round counter —
-    replicates (None)."""
+    replicates (None).
+
+    Packed runs (``p.packed``) fall under the 2-D rule with the WORD
+    axis in place of the changeset axis: cov uint32[N, Wc] and budget
+    uint32[N, Wb] shard (node_axis, change_axis) — a word is 32/lane_bits
+    whole changesets, so a word-axis split is a changeset-axis split and
+    GSPMD still shards the round kernel on ('nodes' × 'changes'); pick
+    shapes where Wc/Wb divide the change_axis mesh extent."""
     out = []
     for x in jax.eval_shape(lambda: init_state(p)):
         ndim = getattr(x, "ndim", 0)
@@ -641,13 +799,16 @@ def run(
     p: SimParams,
     mesh: Optional[Mesh] = None,
     mesh_axis: str = "nodes",
+    change_axis: Optional[str] = None,
     return_state: bool = False,
     chaos=None,
 ) -> SimResult:
     """Run to convergence (or max_rounds); returns timing split into
     compile and execute so the <60 s north star is measured on execute+
     compile both (BASELINE.md reports wall-clock).  ``chaos`` threads an
-    explicit fault schedule into the step (see :func:`make_step`)."""
+    explicit fault schedule into the step (see :func:`make_step`);
+    ``change_axis`` names a second mesh dimension to shard the
+    changeset/word axis over (2-D GSPMD, see :func:`state_shardings`)."""
     if chaos is not None:
         assert chaos.horizon >= p.max_rounds, (
             "lower(sched, horizon=p.max_rounds) so round gathers stay "
@@ -655,7 +816,9 @@ def run(
         )
     state = init_state(p)
     if mesh is not None:
-        shardings = state_shardings(p, mesh, node_axis=mesh_axis)
+        shardings = state_shardings(
+            p, mesh, node_axis=mesh_axis, change_axis=change_axis
+        )
         state = tuple(
             x if s is None else jax.device_put(x, s)
             for x, s in zip(state, shardings)
@@ -678,7 +841,7 @@ def run(
     rounds = int(out[-1])
     t2 = time.perf_counter()
     cov = out[0]
-    converged = bool((cov == jnp.asarray(syncmod.full_masks(p))[None, :]).all())
+    converged = bool((cov == _full_plane(p)[None, :]).all())
     return SimResult(
         converged=converged,
         rounds=rounds,
@@ -701,11 +864,24 @@ def run_trace(
             "schedule's own horizon"
         )
     step = make_step(p, chaos=chaos)
-    full = jnp.asarray(syncmod.full_masks(p))
+    full = _full_plane(p)
+    if p.packed:
+        valid = jnp.asarray(pack.valid_lane_mask(p))
+        cb = pack.lane_bits(p)
+
+        def n_complete(covp):
+            # complete ⇔ the lane of cov XOR full is all-zero; count by
+            # popcount over the lane-LSB flags (padding lanes masked)
+            notc = pack.lane_nonzero(covp ^ full[None, :], cb)
+            return pack.popcount32(valid[None, :] & ~notc).sum()
+    else:
+
+        def n_complete(covp):
+            return (covp == full[None, :]).sum()
 
     def body(state, _):
         state = step(state)
-        return state, (state[0] == full[None, :]).sum()
+        return state, n_complete(state[0])
 
     t0 = time.perf_counter()
     out, counts = jax.block_until_ready(

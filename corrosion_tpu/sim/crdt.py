@@ -38,23 +38,32 @@ def change_keys(p: SimParams, n_keys: int) -> jnp.ndarray:
 
 
 def merge_registers(
-    have: jnp.ndarray, p: SimParams, n_keys: int
+    have: jnp.ndarray, p: SimParams, n_keys: int, packed: bool = False
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(reg, cl): LWW register winners and causal lengths per (node, key).
 
     reg[n, key] = max over {k : have[n, k], key[k]=key} of
     lamport*K + k  (−1 when the node has no data for the key);
     cl[n, key] = number of toggle events node n has received for key.
+
+    With ``packed=True`` the have-matrix arrives as uint32[N, Wc]
+    lane-LSB flag words (cluster.complete_flags_packed) and each node's
+    row is unpacked transiently inside the vmap — the [N, K] boolean
+    (0.5 GB at the 1M-node scale) never materializes.
     """
     K = p.n_changes
     keys = change_keys(p, n_keys)
     lamport = jx_below(
         p.write_rounds, p.seed, TAG_INJECT, jnp.arange(K, dtype=jnp.int32)
     )
-    pack = lamport.astype(jnp.int32) * K + jnp.arange(K, dtype=jnp.int32)
+    stamp = lamport.astype(jnp.int32) * K + jnp.arange(K, dtype=jnp.int32)
 
     def per_node(h):
-        vals = jnp.where(h, pack, jnp.int32(-1))
+        if packed:
+            from . import pack as packmod
+
+            h = packmod.unpack_cov(h, p) != 0
+        vals = jnp.where(h, stamp, jnp.int32(-1))
         reg = jax.ops.segment_max(
             vals, keys, num_segments=n_keys, indices_are_sorted=False
         )

@@ -146,6 +146,13 @@ class SimParams:
     # broadcast draw policy (step 3 above): per-payload distinct draws
     # (runtime-exact) vs shared per-node draws (scale approximation)
     fanout_per_change: bool = True
+    # bitpacked state planes (sim/pack.py): store cov/budget as uint32
+    # words (up to 32 changesets per word) instead of uint8[N, K] /
+    # int8[N, K, S] — 3-5× less live state, same trajectories.  The
+    # packed step is asserted bit-identical in round counts AND state to
+    # the unpacked path and the scalar oracle (tests/test_sim_pack.py);
+    # requires max_transmissions ≤ 15 (≤4-bit budget lanes)
+    packed: bool = False
     seed: int = 0
 
     def with_(self, **kw) -> "SimParams":

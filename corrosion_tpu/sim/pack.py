@@ -1,0 +1,286 @@
+"""Bitpacked state planes: uint32 word layouts for ``cov`` and ``budget``.
+
+The two dominant planes of the sim hot loop are ``cov`` uint8[N, K]
+(chunk-coverage bitmasks) and ``budget`` int8[N, K, S] (per-chunk
+retransmission counters) — ~1.5 GB of live state at the 1M-node scale of
+BASELINE config 4, which is what caps single-chip headroom.  This module
+packs both into uint32 **words** so the hot loop moves 3-5× fewer bytes
+per round (sim/profile.py publishes the exact ratio):
+
+``cov``:  each changeset's uint8 mask occupies one **lane** of
+  ``lane_bits(p)`` bits (the next power of two ≥ nseq_max, so lanes never
+  straddle a word); ``32 // lane_bits`` changesets share a word →
+  ``cov_packed`` uint32[N, Wc], Wc = ceil(K / lanes_per_word).  With
+  nseq_max=1 (configs 1/2/4/5) that is 32 changesets per word — 8× fewer
+  bytes than uint8[N, K].
+
+``budget``: counters are small non-negatives (≤ max_transmissions ≤ 15),
+  stored as ``budget_lane_bits(p)``-bit unsigned lanes (2 bits when
+  max_transmissions ≤ 3 — every BASELINE config — else 4), flattened over
+  (k, s) →  ``budget_packed`` uint32[N, Wb],
+  Wb = ceil(K*S / budget_lanes_per_word).  2-bit lanes are 4× fewer bytes
+  than int8[N, K, S].
+
+All algebra on packed words is shift/mask/popcount arithmetic chosen so
+lanes never interact (no carries cross a lane boundary — see the
+individual helpers); the packed step in sim/cluster.py is asserted
+bit-identical in round counts and state to the unpacked path and the
+scalar oracle (sim/reference.py) by tests/test_sim_pack.py.
+
+Functions operate on the LAST axis, so the same helpers serve the [N, K]
+state planes, single rows inside ``vmap`` (sim/crdt.py), and any leading
+batch shape.  Scalar ``py_``-style twins (pure-python, per row) back the
+round-trip property tests with an independent implementation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from .model import SimParams
+
+# -- layout (static per SimParams) ------------------------------------------
+
+
+def lane_bits(p: SimParams) -> int:
+    """Bits per cov lane: next power of two ≥ nseq_max, so a changeset's
+    chunk mask never straddles a uint32 word boundary."""
+    s = max(1, p.nseq_max)
+    assert s <= 8, "coverage masks are uint8 (nseq_array asserts this too)"
+    for w in (1, 2, 4, 8):
+        if s <= w:
+            return w
+    raise AssertionError("unreachable")
+
+
+def lanes_per_word(p: SimParams) -> int:
+    return 32 // lane_bits(p)
+
+
+def cov_words(p: SimParams) -> int:
+    """Packed cov width Wc: uint32 words per node row."""
+    lanes = lanes_per_word(p)
+    return -(-p.n_changes // lanes)
+
+
+def budget_lane_bits(p: SimParams) -> int:
+    """Bits per budget lane: counters are ≤ max_transmissions, so 2 bits
+    when that fits (≤ 3 — every BASELINE config) else 4 (≤ 15)."""
+    assert 0 <= p.max_transmissions <= 15, (
+        "packed budgets store counters in ≤4-bit lanes"
+    )
+    return 2 if p.max_transmissions <= 3 else 4
+
+
+def budget_lanes_per_word(p: SimParams) -> int:
+    return 32 // budget_lane_bits(p)
+
+
+def budget_words(p: SimParams) -> int:
+    """Packed budget width Wb: uint32 words per node row, lanes flattened
+    over (changeset, chunk)."""
+    s = max(1, p.nseq_max)
+    return -(-(p.n_changes * s) // budget_lanes_per_word(p))
+
+
+# lane-selector masks: one bit at each lane's LSB / a full lane of ones
+def lane_lsb_mask(bits: int) -> int:
+    """uint32 with bit set at every lane LSB (0x55.. for 2-bit lanes,
+    0x11.. for 4-bit, 0x01010101 for 8-bit, all-ones for 1-bit)."""
+    m = 0
+    for i in range(0, 32, bits):
+        m |= 1 << i
+    return m
+
+
+# -- pack / unpack (last-axis, any leading shape) ---------------------------
+
+
+def _pack_lanes(values: jnp.ndarray, bits: int, n_words: int) -> jnp.ndarray:
+    """Pack (..., L) small non-negative ints (< 2**bits each) into
+    (..., n_words) uint32, lane i of word w holding element w*lanes + i.
+    Shifted lanes are disjoint, so the sum is a bitwise OR."""
+    lanes = 32 // bits
+    total = n_words * lanes
+    x = values.astype(jnp.uint32)
+    pad = total - x.shape[-1]
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros(x.shape[:-1] + (pad,), dtype=jnp.uint32)], axis=-1
+        )
+    x = x.reshape(x.shape[:-1] + (n_words, lanes))
+    shifts = jnp.arange(lanes, dtype=jnp.uint32) * jnp.uint32(bits)
+    return jnp.sum(x << shifts, axis=-1, dtype=jnp.uint32)
+
+
+def _unpack_lanes(words: jnp.ndarray, bits: int, n: int) -> jnp.ndarray:
+    """Inverse of :func:`_pack_lanes`: (..., W) uint32 → (..., n) uint32."""
+    lanes = 32 // bits
+    shifts = jnp.arange(lanes, dtype=jnp.uint32) * jnp.uint32(bits)
+    x = (words[..., None] >> shifts) & jnp.uint32((1 << bits) - 1)
+    return x.reshape(x.shape[:-2] + (x.shape[-2] * lanes,))[..., :n]
+
+
+def pack_cov(cov: jnp.ndarray, p: SimParams) -> jnp.ndarray:
+    """(..., K) uint8 chunk masks → (..., Wc) uint32 packed words."""
+    return _pack_lanes(cov, lane_bits(p), cov_words(p))
+
+
+def unpack_cov(words: jnp.ndarray, p: SimParams) -> jnp.ndarray:
+    """(..., Wc) uint32 packed words → (..., K) uint8 chunk masks."""
+    return _unpack_lanes(words, lane_bits(p), p.n_changes).astype(jnp.uint8)
+
+
+def pack_flags(flags: jnp.ndarray, p: SimParams) -> jnp.ndarray:
+    """(..., K) bools → cov-layout words with each lane's LSB carrying the
+    flag (compose with :func:`lane_fill` for full-lane select masks)."""
+    return _pack_lanes(flags.astype(jnp.uint32), lane_bits(p), cov_words(p))
+
+
+def pack_budget(budget: jnp.ndarray, p: SimParams) -> jnp.ndarray:
+    """(..., K, S) int8 counters → (..., Wb) uint32 packed words."""
+    s = max(1, p.nseq_max)
+    flat = budget.reshape(budget.shape[:-2] + (p.n_changes * s,))
+    return _pack_lanes(flat, budget_lane_bits(p), budget_words(p))
+
+
+def unpack_budget(words: jnp.ndarray, p: SimParams) -> jnp.ndarray:
+    """(..., Wb) uint32 packed words → (..., K, S) int8 counters."""
+    s = max(1, p.nseq_max)
+    flat = _unpack_lanes(words, budget_lane_bits(p), p.n_changes * s)
+    return flat.reshape(flat.shape[:-1] + (p.n_changes, s)).astype(jnp.int8)
+
+
+def pack_chunk_flags(flags: jnp.ndarray, p: SimParams) -> jnp.ndarray:
+    """(..., K, S) bools → budget-layout words with each lane's LSB
+    carrying the flag."""
+    s = max(1, p.nseq_max)
+    flat = flags.astype(jnp.uint32).reshape(flags.shape[:-2] + (p.n_changes * s,))
+    return _pack_lanes(flat, budget_lane_bits(p), budget_words(p))
+
+
+def cov_words_to_chunk_flags(words: jnp.ndarray, p: SimParams) -> jnp.ndarray:
+    """cov-layout words → budget-layout lane-LSB flags: flag (k, s) set
+    iff chunk bit s of changeset k is set.  Pure shift/reshape — the
+    bridge the packed receive phase uses to turn newly-landed chunk words
+    into per-counter budget refresh masks."""
+    s_dim = max(1, p.nseq_max)
+    u = _unpack_lanes(words, lane_bits(p), p.n_changes)  # (..., K) lane values
+    srange = jnp.arange(s_dim, dtype=jnp.uint32)
+    b = (u[..., None] >> srange) & jnp.uint32(1)  # (..., K, S)
+    flat = b.reshape(b.shape[:-2] + (p.n_changes * s_dim,))
+    return _pack_lanes(flat, budget_lane_bits(p), budget_words(p))
+
+
+# -- host-side layout constants ---------------------------------------------
+
+
+def np_pack_row(values: Sequence[int], bits: int, n_words: int) -> np.ndarray:
+    """Host/NumPy twin of :func:`_pack_lanes` for one row (used eagerly
+    for trace-time constants like the packed full masks)."""
+    lanes = 32 // bits
+    out = np.zeros(n_words, dtype=np.uint32)
+    for i, v in enumerate(values):
+        out[i // lanes] |= np.uint32(int(v) << (bits * (i % lanes)))
+    return out
+
+
+def full_masks_packed(p: SimParams) -> np.ndarray:
+    """[Wc] uint32: packed twin of sync.full_masks — the all-chunks
+    coverage word per packed column."""
+    from . import sync as syncmod
+
+    return np_pack_row(syncmod.full_masks(p), lane_bits(p), cov_words(p))
+
+
+def valid_lane_mask(p: SimParams) -> np.ndarray:
+    """[Wc] uint32 with each REAL changeset lane's LSB set — padding lanes
+    clear, so lane-LSB reductions (complete counts) never count padding."""
+    return np_pack_row([1] * p.n_changes, lane_bits(p), cov_words(p))
+
+
+# -- lane algebra (carry-free word arithmetic) ------------------------------
+
+
+def lane_nonzero(words: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """OR-fold each lane onto its LSB: output has each lane's LSB set iff
+    the lane held ANY set bit (all other bits cleared).  The fold shifts
+    pull neighbouring-lane bits downward too, but those land above the
+    LSB and the final mask drops them."""
+    x = words
+    if bits >= 2:
+        x = x | (x >> jnp.uint32(1))
+    if bits >= 4:
+        x = x | (x >> jnp.uint32(2))
+    if bits >= 8:
+        x = x | (x >> jnp.uint32(4))
+    return x & jnp.uint32(lane_lsb_mask(bits))
+
+
+def lane_fill(lsb_bits: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Spread lane-LSB flags to full-lane masks: multiplying a 0/1 LSB by
+    the all-ones lane constant writes the whole lane and cannot carry
+    (disjoint lanes, products < 2**bits)."""
+    return lsb_bits * jnp.uint32((1 << bits) - 1)
+
+
+def popcount32(x: jnp.ndarray) -> jnp.ndarray:
+    """int32 set-bit counts via the SWAR reduction (pairwise field sums:
+    2-bit, then 4-bit, then one multiply-accumulate folds the byte sums
+    into the top byte) — no 256-entry table gather in the hot loop."""
+    x = x.astype(jnp.uint32)
+    x = x - ((x >> jnp.uint32(1)) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> jnp.uint32(2)) & jnp.uint32(0x33333333))
+    x = (x + (x >> jnp.uint32(4))) & jnp.uint32(0x0F0F0F0F)
+    return ((x * jnp.uint32(0x01010101)) >> jnp.uint32(24)).astype(jnp.int32)
+
+
+# -- scalar twins (independent implementation for the property tests) -------
+
+
+def py_pack_cov_row(cov_row: Sequence[int], p: SimParams) -> List[int]:
+    """Scalar twin of :func:`pack_cov` for one node row."""
+    bits, lanes = lane_bits(p), lanes_per_word(p)
+    out = [0] * cov_words(p)
+    for k, m in enumerate(cov_row):
+        assert 0 <= int(m) < (1 << bits)
+        out[k // lanes] |= int(m) << (bits * (k % lanes))
+    return out
+
+
+def py_unpack_cov_row(words: Sequence[int], p: SimParams) -> List[int]:
+    bits, lanes = lane_bits(p), lanes_per_word(p)
+    return [
+        (int(words[k // lanes]) >> (bits * (k % lanes))) & ((1 << bits) - 1)
+        for k in range(p.n_changes)
+    ]
+
+
+def py_pack_budget_row(budget_row: Sequence[Sequence[int]], p: SimParams) -> List[int]:
+    """Scalar twin of :func:`pack_budget` for one node row ([K][S] ints)."""
+    bits, lanes = budget_lane_bits(p), budget_lanes_per_word(p)
+    s_dim = max(1, p.nseq_max)
+    out = [0] * budget_words(p)
+    for k in range(p.n_changes):
+        for s in range(s_dim):
+            v = int(budget_row[k][s])
+            assert 0 <= v < (1 << bits)
+            j = k * s_dim + s
+            out[j // lanes] |= v << (bits * (j % lanes))
+    return out
+
+
+def py_unpack_budget_row(words: Sequence[int], p: SimParams) -> List[List[int]]:
+    bits, lanes = budget_lane_bits(p), budget_lanes_per_word(p)
+    s_dim = max(1, p.nseq_max)
+    out = []
+    for k in range(p.n_changes):
+        row = []
+        for s in range(s_dim):
+            j = k * s_dim + s
+            row.append((int(words[j // lanes]) >> (bits * (j % lanes))) & ((1 << bits) - 1))
+        out.append(row)
+    return out

@@ -1,0 +1,382 @@
+"""Roofline instrumentation for the sim hot loop: bytes per round,
+achieved memory bandwidth, and utilization against the device peak.
+
+The round kernel is gather/scatter-bound, not FLOP-bound, so the honest
+performance question is "what fraction of peak HBM bandwidth does one
+round sustain?" (VERDICT round 5; the blocking-communication accounting
+in Factored Gossip DiLoCo, PAPERS.md, motivates measuring bytes moved
+instead of guessing).  This module answers it three ways and publishes
+the arithmetic:
+
+1. **state floor** — live state bytes from ``jax.eval_shape`` over
+   ``cluster.init_state`` (no allocation, so the 1M/4M shapes can be
+   budgeted on any host): every round must at least read and write the
+   carry, so ``2 × live_bytes`` is the compulsory-traffic floor.
+2. **XLA accounting** — ``compiled.cost_analysis()['bytes accessed']``
+   of one jitted round step: the compiler's own estimate including the
+   transient scatter planes and fanout-target tensors.
+3. **measurement** — wall time of one warm round; achieved bandwidth =
+   XLA bytes / round seconds, utilization = achieved / peak.  Peak comes
+   from a device-kind table for TPUs and a measured large-copy bandwidth
+   everywhere else (an honest, if generous, proxy on CPU hosts — the
+   verdict line names which basis was used).
+
+Emits ``corro.sim.hbm_bytes_per_round``, ``corro.sim.hbm_utilization``
+and ``corro.sim.live_state_bytes`` (doc/telemetry.md); bench.py folds
+:func:`bench_fields` into its JSON lines, and
+``python -m corrosion_tpu.sim.profile --update-benchmarks`` regenerates
+the roofline section of BENCHMARKS.md from that JSON — the table is
+generated, never hand-edited.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional
+
+# peak HBM bandwidth per accelerator generation, bytes/second (public
+# spec sheets; matched by lowercase substring of device_kind)
+PEAK_HBM_BYTES_PER_S = {
+    "v6e": 1.64e12,
+    "v6": 1.64e12,
+    "v5p": 2.765e12,
+    "v5e": 0.819e12,
+    "v5 lite": 0.819e12,
+    "v4": 1.228e12,
+    "v3": 0.90e12,
+    "v2": 0.70e12,
+}
+
+
+@dataclass
+class RoundProfile:
+    """One config's roofline numbers (all byte counts per single round)."""
+
+    device: str
+    device_kind: str
+    n_nodes: int
+    n_changes: int
+    packed: bool
+    live_state_bytes: int
+    live_state_bytes_unpacked: int
+    floor_bytes_per_round: int  # 2 × live state (read + write the carry)
+    xla_bytes_per_round: Optional[int]  # compiler's bytes-accessed estimate
+    round_s: float  # warm wall time of one step
+    achieved_bytes_per_s: float
+    peak_bytes_per_s: float
+    peak_basis: str  # "spec:<kind>" or "measured-copy"
+    hbm_utilization: float  # achieved / peak, in [0, ~1]
+
+
+def plane_bytes(p) -> Dict[str, int]:
+    """Per-plane live-state bytes via eval_shape (nothing allocated, so
+    4M-node budgets are computable on a laptop)."""
+    import jax
+
+    from . import cluster
+
+    names = ("cov", "budget", "status", "since", "round")
+    shapes = jax.eval_shape(lambda: cluster.init_state(p))
+    return {
+        name: int(x.size) * x.dtype.itemsize
+        for name, x in zip(names, shapes)
+    }
+
+
+def live_state_bytes(p) -> int:
+    return sum(plane_bytes(p).values())
+
+
+def peak_round_bytes_estimate(p) -> int:
+    """Rough device-memory need of one round: live state plus the
+    transient per-changeset planes (delivered/scatter/pend masks) that
+    exist between fusion boundaries — the guard bench.py consults before
+    attempting the 1M-node headroom run."""
+    transient = 6 * p.n_nodes * p.n_changes
+    return live_state_bytes(p) + transient
+
+
+def measured_copy_bandwidth(n_bytes: int = 1 << 28, reps: int = 3) -> float:
+    """Bytes/s of a large on-device copy (read + write counted) — the
+    peak-bandwidth stand-in where no spec number applies (CPU hosts)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = n_bytes // 4
+    x = jax.block_until_ready(jnp.zeros((n,), dtype=jnp.uint32))
+    copy = jax.jit(lambda a: a + jnp.uint32(1))
+    jax.block_until_ready(copy(x))  # compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(copy(x))
+        best = min(best, time.perf_counter() - t0)
+    return (2 * n * 4) / best
+
+
+def peak_bandwidth(device) -> tuple:
+    """(bytes/s, basis) for ``device`` — spec table for known TPU kinds,
+    measured copy everywhere else."""
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for key, bw in PEAK_HBM_BYTES_PER_S.items():
+        if key in kind:
+            return bw, f"spec:{key}"
+    return measured_copy_bandwidth(), "measured-copy"
+
+
+def _bytes_accessed(compiled) -> Optional[int]:
+    """'bytes accessed' from XLA cost analysis (shape differs across jax
+    versions: dict, or list of per-device dicts)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    v = ca.get("bytes accessed")
+    return int(v) if v is not None else None
+
+
+def profile_round(p, reps: int = 3, device=None) -> RoundProfile:
+    """Compile one round step for ``p``, time it warm, and assemble the
+    roofline numbers.  Also sets the corro.sim.* gauges."""
+    import jax
+
+    from ..utils.metrics import registry
+    from . import cluster
+
+    dev = device if device is not None else jax.devices()[0]
+    step = cluster.make_step(p)
+    state = cluster.init_state(p)
+    compiled = jax.jit(step).lower(state).compile()
+    out = jax.block_until_ready(compiled(state))  # warm-up execute
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(compiled(state))
+        int(out[-1])  # device→host scalar fetch: see the axon note in run()
+        best = min(best, time.perf_counter() - t0)
+
+    live = live_state_bytes(p)
+    live_unpacked = live_state_bytes(p.with_(packed=False))
+    xla_bytes = _bytes_accessed(compiled)
+    moved = xla_bytes if xla_bytes is not None else 2 * live
+    peak, basis = peak_bandwidth(dev)
+    achieved = moved / best
+    prof = RoundProfile(
+        device=dev.platform,
+        device_kind=getattr(dev, "device_kind", dev.platform),
+        n_nodes=p.n_nodes,
+        n_changes=p.n_changes,
+        packed=p.packed,
+        live_state_bytes=live,
+        live_state_bytes_unpacked=live_unpacked,
+        floor_bytes_per_round=2 * live,
+        xla_bytes_per_round=xla_bytes,
+        round_s=best,
+        achieved_bytes_per_s=achieved,
+        peak_bytes_per_s=peak,
+        peak_basis=basis,
+        hbm_utilization=achieved / peak if peak > 0 else 0.0,
+    )
+    label = str(p.n_nodes)
+    registry.gauge("corro.sim.hbm_bytes_per_round", nodes=label).set(float(moved))
+    registry.gauge("corro.sim.hbm_utilization", nodes=label).set(
+        prof.hbm_utilization
+    )
+    registry.gauge("corro.sim.live_state_bytes", nodes=label).set(float(live))
+    return prof
+
+
+def bench_fields(prof: RoundProfile) -> Dict[str, object]:
+    """The subset of a RoundProfile bench.py folds into its JSON lines
+    (names stable — the BENCHMARKS.md generator reads them back)."""
+    moved = (
+        prof.xla_bytes_per_round
+        if prof.xla_bytes_per_round is not None
+        else prof.floor_bytes_per_round
+    )
+    return {
+        "packed": prof.packed,
+        "live_state_bytes": prof.live_state_bytes,
+        "live_state_bytes_unpacked": prof.live_state_bytes_unpacked,
+        "hbm_bytes_per_round": moved,
+        "round_s": round(prof.round_s, 6),
+        "achieved_gbps": round(prof.achieved_bytes_per_s / 1e9, 1),
+        "peak_gbps": round(prof.peak_bytes_per_s / 1e9, 1),
+        "peak_basis": prof.peak_basis,
+        "hbm_utilization": round(prof.hbm_utilization, 4),
+    }
+
+
+# -- BENCHMARKS.md roofline section (generated, never hand-edited) ----------
+
+BEGIN_MARK = "<!-- roofline:begin (generated by corrosion_tpu.sim.profile; do not hand-edit) -->"
+END_MARK = "<!-- roofline:end -->"
+
+# round-5 warm execute_s to compare against (BENCH_r05.json)
+ROUND5_WARM_EXECUTE_S = {"config4": 2.592, "config5": 4.666}
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "—"
+    for unit, div in (("GB", 1e9), ("MB", 1e6), ("KB", 1e3)):
+        if n >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{int(n)} B"
+
+
+def roofline_markdown(lines: List[dict]) -> str:
+    """Render the roofline section from bench JSON lines (one dict per
+    config, as printed by bench.py)."""
+    out = [
+        BEGIN_MARK,
+        "",
+        "## Roofline: HBM bytes per round vs achieved bandwidth",
+        "",
+        "The round kernel is gather/scatter-bound; the relevant roofline is",
+        "the memory roof.  Per config: bytes moved per round (XLA's",
+        "bytes-accessed for one compiled step), the warm per-round time",
+        "(`warm_execute_s / rounds`), achieved bandwidth = bytes/round ÷",
+        "round time, and utilization = achieved ÷ peak.  `peak_basis`",
+        "`spec:*` is the device's HBM spec number; `measured-copy` is a",
+        "large on-device copy (CPU hosts — a generous proxy, so treat the",
+        "utilization as an upper bound there).  Live-state bytes compare",
+        "the packed (uint32 word planes, sim/pack.py) against the unpacked",
+        "(uint8/int8) layout the round-5 numbers were measured on.",
+        "",
+        "| metric | device | rounds | warm execute | s/round | bytes/round "
+        "| achieved | peak (basis) | util | live state (packed / unpacked) "
+        "| vs r05 warm |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for ln in lines:
+        metric = ln.get("metric", "?")
+        rounds = ln.get("rounds") or 0
+        warm = ln.get("warm_execute_s")
+        s_round = (warm / rounds) if (warm and rounds) else ln.get("round_s")
+        ach = ln.get("achieved_gbps")
+        peak = ln.get("peak_gbps")
+        util = ln.get("hbm_utilization")
+        vs = "—"
+        for cfg, base in ROUND5_WARM_EXECUTE_S.items():
+            # only comparable at the scale round 5 actually measured (100k)
+            if cfg in metric and warm and metric.startswith("sim_100000n_"):
+                vs = f"{base / warm:.2f}×"
+        out.append(
+            "| {m} | {d} | {r} | {w} | {sr} | {b} | {a} | {p} ({pb}) | {u} "
+            "| {lp} / {lu} | {vs} |".format(
+                m=metric.replace("sim_", "").replace("_convergence_wall", ""),
+                d=ln.get("device", "?"),
+                r=rounds or "—",
+                w=f"{warm:.2f} s" if warm else "—",
+                sr=f"{s_round * 1e3:.1f} ms" if s_round else "—",
+                b=_fmt_bytes(ln.get("hbm_bytes_per_round")),
+                a=f"{ach:.0f} GB/s" if ach is not None else "—",
+                p=f"{peak:.0f} GB/s" if peak is not None else "—",
+                pb=ln.get("peak_basis", "?"),
+                u=f"{util * 100:.0f}%" if util is not None else "—",
+                lp=_fmt_bytes(ln.get("live_state_bytes")),
+                lu=_fmt_bytes(ln.get("live_state_bytes_unpacked")),
+                vs=vs,
+            )
+        )
+    utils = [
+        ln["hbm_utilization"]
+        for ln in lines
+        if ln.get("hbm_utilization") is not None
+    ]
+    if utils:
+        top = max(utils)
+        if top >= 1.0:
+            verdict = (
+                f"**Verdict: bandwidth-bound** — best config moves bytes at "
+                f"{top * 100:.0f}% of the measured-copy proxy, i.e. faster "
+                "than a plain streaming copy: the hot loop's working set is "
+                "partially cache-resident on this host, so the true DRAM "
+                "roof is already saturated.  Re-run on a TPU to get a "
+                "spec-basis utilization."
+            )
+        elif top >= 0.5:
+            verdict = (
+                f"**Verdict: bandwidth-bound** — best config sustains "
+                f"{top * 100:.0f}% of peak; the remaining headroom is "
+                "scatter/gather latency, not untouched bandwidth."
+            )
+        else:
+            verdict = (
+                f"**Verdict: not yet bandwidth-bound** — best config "
+                f"sustains {top * 100:.0f}% of peak; the gap is "
+                "gather/scatter issue latency and per-mechanism overhead, "
+                "which is why the packed planes + fused redraws matter "
+                "more than raw byte counts here."
+            )
+        out += ["", verdict]
+    out += ["", END_MARK]
+    return "\n".join(out)
+
+
+def update_benchmarks(bench_json_path: str, md_path: str) -> None:
+    """Replace (or append) the marker-delimited roofline section of
+    ``md_path`` from the JSON lines in ``bench_json_path``."""
+    lines = []
+    with open(bench_json_path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    lines.append(json.loads(raw))
+                except json.JSONDecodeError:
+                    pass
+    section = roofline_markdown(lines)
+    with open(md_path) as f:
+        doc = f.read()
+    if BEGIN_MARK in doc and END_MARK in doc:
+        head, rest = doc.split(BEGIN_MARK, 1)
+        _, tail = rest.split(END_MARK, 1)
+        doc = head + section + tail
+    else:
+        doc = doc.rstrip("\n") + "\n\n" + section + "\n"
+    with open(md_path, "w") as f:
+        f.write(doc)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", type=int, default=4)
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--unpacked", action="store_true")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument(
+        "--update-benchmarks",
+        action="store_true",
+        help="regenerate the BENCHMARKS.md roofline section from --bench",
+    )
+    ap.add_argument("--bench", default="BENCH_r06.json")
+    ap.add_argument("--md", default="BENCHMARKS.md")
+    args = ap.parse_args()
+
+    if args.update_benchmarks:
+        update_benchmarks(args.bench, args.md)
+        print(f"updated {args.md} from {args.bench}", file=sys.stderr)
+        return
+
+    from . import model
+
+    p = model.CONFIGS[args.config]()
+    if args.scale != 1.0:
+        p = p.with_(n_nodes=max(8, int(p.n_nodes * args.scale)))
+    p = p.with_(packed=not args.unpacked)
+    prof = profile_round(p, reps=args.reps)
+    print(json.dumps(asdict(prof)))
+
+
+if __name__ == "__main__":
+    main()

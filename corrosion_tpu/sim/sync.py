@@ -105,7 +105,14 @@ for _m in range(256):
 
 
 def jx_popcount8(m: jnp.ndarray) -> jnp.ndarray:
-    return jnp.take(jnp.asarray(_POPCOUNT8), m.astype(jnp.int32))
+    """Set-bit count per uint8 mask — SWAR field sums (2-bit, 4-bit, byte)
+    instead of a 256-entry table gather, so the hot loop stays pure
+    shift/mask arithmetic (gathers are the expensive op on this workload;
+    the scalar twin still reads the table, cross-checked by tests)."""
+    x = m.astype(jnp.uint32)
+    x = x - ((x >> jnp.uint32(1)) & jnp.uint32(0x55))
+    x = (x & jnp.uint32(0x33)) + ((x >> jnp.uint32(2)) & jnp.uint32(0x33))
+    return ((x + (x >> jnp.uint32(4))) & jnp.uint32(0x0F)).astype(jnp.int32)
 
 
 def py_popcount8(m: int) -> int:
@@ -196,6 +203,50 @@ def py_available(
         else:
             out.append(0)  # case 2: gap, peer partial → not served
     return out
+
+
+# -- the needs rule on packed words (sim/pack.py layout) --------------------
+
+
+def jx_available_packed(
+    mine_w: jnp.ndarray,  # [N, Wc] uint32 (receiver rows, packed)
+    theirs_w: jnp.ndarray,  # [N, Wc] uint32 (peer rows, aligned)
+    full_w: jnp.ndarray,  # [Wc] uint32 packed full masks
+    heads_mine: jnp.ndarray,  # [N, A] int32 (receiver heads)
+    aidx,
+    vidx,
+    p: SimParams,
+) -> jnp.ndarray:
+    """[N, Wc] uint32: packed twin of :func:`jx_available` — the same
+    three-case serving rule as carry-free word algebra, one word = up to
+    32 changesets.  Case flags land on lane LSBs and fan out to full-lane
+    select masks:
+
+    - case 3 (our partial, seq-wise): ``lane_nonzero(mine)`` — any
+      coverage bit in the lane;
+    - case 2 (gap, peer complete): complete ⇔ the lane of
+      ``theirs XOR full`` is all-zero, so its ``lane_nonzero`` bit is
+      CLEAR — complement against the lane-LSB mask;
+    - case 1 (above our head): per-changeset version/head compare (int32,
+      not maskable) packed onto lane LSBs via ``pack_flags``.
+
+    Padding lanes: full/theirs are both zero there, which reads as "peer
+    complete" — harmless, since ``miss`` is zero on padding too.  Equals
+    ``pack_cov(jx_available(unpack(...)))`` bit for bit
+    (tests/test_sim_pack.py)."""
+    from . import pack
+
+    bits = pack.lane_bits(p)
+    lsb = jnp.uint32(pack.lane_lsb_mask(bits))
+    miss = theirs_w & ~mine_w
+    has_any = pack.lane_nonzero(mine_w, bits)
+    not_complete = pack.lane_nonzero(theirs_w ^ full_w[None, :], bits)
+    head_per_k = jnp.take_along_axis(
+        heads_mine, jnp.asarray(aidx)[None, :], axis=1
+    )
+    above_head = jnp.asarray(vidx)[None, :] > head_per_k
+    serve = pack.pack_flags(above_head, p) | has_any | (lsb & ~not_complete)
+    return miss & pack.lane_fill(serve, bits)
 
 
 # -- budgeted (version, seq)-ordered transfer -------------------------------

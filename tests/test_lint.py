@@ -478,6 +478,87 @@ def make_step(p, chaos):
     assert trace_rules(good) == set()
 
 
+# -- bitpacked kernels: lint gate + trace-safety fixtures ---------------------
+
+def test_cli_lint_packed_kernels_clean_at_warning():
+    """ISSUE 3 satellite: the packing layer and roofline profiler hold the
+    warning bar — sim/pack.py, sim/profile.py and the packed hot path in
+    cluster.py/sync.py all lint clean at --fail-on warning."""
+    proc = cli_lint([
+        "--fail-on=warning",
+        "corrosion_tpu/sim/pack.py",
+        "corrosion_tpu/sim/profile.py",
+        "corrosion_tpu/sim/cluster.py",
+        "corrosion_tpu/sim/sync.py",
+    ])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_gl101_python_popcount_loop_on_tracer():
+    # the bug the SWAR popcount exists to avoid: data-dependent Python
+    # looping over a traced word's bits
+    bad = """
+import jax
+def step(word):
+    n = 0
+    while word:
+        n += word & 1
+        word >>= 1
+    return n
+jax.jit(step)
+"""
+    assert "GL101" in trace_rules(bad)
+
+
+def test_swar_popcount_shift_idiom_not_flagged():
+    # the shipped idiom (sim/pack.py popcount32, sim/sync.py jx_popcount8):
+    # branch-free shift/mask algebra with explicit uint32 constants
+    good = """
+import jax, jax.numpy as jnp
+def step(x):
+    x = x.astype(jnp.uint32)
+    x = x - ((x >> jnp.uint32(1)) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> jnp.uint32(2)) & jnp.uint32(0x33333333))
+    x = (x + (x >> jnp.uint32(4))) & jnp.uint32(0x0F0F0F0F)
+    return ((x * jnp.uint32(0x01010101)) >> jnp.uint32(24)).astype(jnp.int32)
+jax.jit(step)
+"""
+    assert trace_rules(good) == set()
+
+
+def test_gl105_dtypeless_shift_table_in_packing_helper():
+    # lane-shift tables MUST pin uint32: a dtype-less arange defaults to
+    # int32/int64 and poisons the word dtype through `<<` promotion
+    bad = """
+import jax, jax.numpy as jnp
+def pack_lanes(values, bits, lanes):
+    shifts = jnp.arange(lanes) * bits
+    return jnp.sum(values << shifts, axis=-1)
+jax.jit(lambda v: pack_lanes(v, 4, 8))
+"""
+    assert "GL105" in trace_rules(bad)
+
+
+def test_packed_lane_algebra_idiom_not_flagged():
+    # lane_nonzero/lane_fill as shipped: explicit dtypes, host-int lane
+    # constants folded via jnp.uint32(...)
+    good = """
+import jax, jax.numpy as jnp
+def lane_nonzero(words, bits: int):
+    x = words
+    if bits >= 2:
+        x = x | (x >> jnp.uint32(1))
+    if bits >= 4:
+        x = x | (x >> jnp.uint32(2))
+    m = 0
+    for i in range(0, 32, bits):
+        m |= 1 << i
+    return x & jnp.uint32(m)
+jax.jit(lambda w: lane_nonzero(w, 4))
+"""
+    assert trace_rules(good) == set()
+
+
 # -- agent --self-check metric -----------------------------------------------
 
 def test_self_check_emits_lint_findings_total():

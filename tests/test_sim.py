@@ -238,6 +238,30 @@ def test_sharded_run_matches_single_device():
     assert res.rounds == ref.rounds
 
 
+@pytest.mark.parametrize("packed", [False, True])
+def test_sharded_2d_mesh_matches_single_device(packed):
+    """('nodes' × 'changes') GSPMD at config 3's regime (power-law
+    topology, seq-chunked multi-bit coverage, budgeted needs-based sync):
+    the 2D-sharded run must converge in exactly the single-device round
+    count — in both state layouts, since the packed cov plane shards its
+    uint32 WORD axis where the unpacked one shards changesets."""
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must force 8 virtual CPU devices"
+    from jax.sharding import Mesh
+
+    # n_nodes % 4 == 0 and, packed, Wc = 32/8 lanes = 4 words % 2 == 0
+    p = model.config3_powerlaw10k(seed=7).with_(
+        n_nodes=256, n_changes=32, write_rounds=4, max_rounds=256,
+        packed=packed,
+    )
+    single = cluster.run(p)
+    assert single.converged
+    mesh = Mesh(np.array(devs[:8]).reshape(4, 2), ("nodes", "changes"))
+    res = cluster.run(p, mesh=mesh, change_axis="changes")
+    assert res.converged
+    assert res.rounds == single.rounds
+
+
 # -- CRDT merge analysis ----------------------------------------------------
 
 

@@ -1,0 +1,229 @@
+"""Bitpacked state-plane tests (sim/pack.py + the packed hot path).
+
+Three layers of evidence that the uint32 word layout is exactly the
+uint8/int8 layout, cheaper:
+
+1. pack/unpack round-trip properties against independent scalar twins,
+   across every lane geometry the configs produce (1/4/8-bit cov lanes,
+   2/4-bit budget lanes);
+2. full mid-flight state equality packed-vs-unpacked, and exact
+   round-count fidelity vs the CPU reference for all five BASELINE
+   configs at n=128 with ``packed=True``;
+3. the memory claim itself: >= 3× live-state reduction at the 1M-node
+   scale, computed via eval_shape so no 1M-node array is ever allocated.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim import cluster, model, pack, profile, reference
+
+
+def packed_configs():
+    """The five BASELINE configs at n=128, packed (fidelity matrix)."""
+    return {
+        "config1_ring3": model.config1_ring3(seed=7).with_(packed=True),
+        "config2_er": model.config2_er1k(seed=7).with_(
+            n_nodes=128, n_changes=16, max_rounds=128, packed=True
+        ),
+        "config3_powerlaw": model.config3_powerlaw10k(seed=7).with_(
+            n_nodes=128, n_changes=16, write_rounds=4, max_rounds=256,
+            packed=True,
+        ),
+        "config4_churn": model.config4_churn100k(seed=7).with_(
+            n_nodes=128, n_changes=16, write_rounds=4,
+            churn_rounds=6, max_rounds=256, packed=True,
+        ),
+        "config5_partition": model.config5_partition100k(seed=7).with_(
+            n_nodes=128, n_changes=16, write_rounds=4,
+            partition_rounds=10, max_rounds=256, packed=True,
+        ),
+    }
+
+
+# -- pack/unpack round-trip properties vs the scalar twins ------------------
+
+
+def _layout_params(nseq_max: int, max_transmissions: int) -> model.SimParams:
+    return model.SimParams(
+        n_nodes=16,
+        n_changes=37,  # deliberately not a multiple of any lane count
+        fanout=2,
+        max_transmissions=max_transmissions,
+        sync_interval=2,
+        write_rounds=1,
+        max_rounds=8,
+        nseq_max=nseq_max,
+        seed=0,
+    )
+
+
+@pytest.mark.parametrize("nseq", [1, 3, 4, 8])
+def test_cov_roundtrip_matches_scalar_twin(nseq):
+    p = _layout_params(nseq, 2)
+    bits = pack.lane_bits(p)
+    rng = np.random.default_rng(nseq)
+    cov = rng.integers(0, 1 << bits, size=(p.n_nodes, p.n_changes)).astype(
+        np.uint8
+    )
+    words = np.asarray(pack.pack_cov(jnp.asarray(cov), p))
+    assert words.dtype == np.uint32
+    assert words.shape == (p.n_nodes, pack.cov_words(p))
+    for n in range(p.n_nodes):
+        assert words[n].tolist() == pack.py_pack_cov_row(cov[n], p)
+        assert pack.py_unpack_cov_row(words[n], p) == cov[n].tolist()
+    back = np.asarray(pack.unpack_cov(jnp.asarray(words), p))
+    assert (back == cov).all()
+
+
+@pytest.mark.parametrize("max_tx", [2, 3, 10, 15])
+def test_budget_roundtrip_matches_scalar_twin(max_tx):
+    p = _layout_params(4, max_tx)
+    bits = pack.budget_lane_bits(p)
+    assert bits == (2 if max_tx <= 3 else 4)
+    rng = np.random.default_rng(max_tx)
+    bud = rng.integers(
+        0, min(max_tx, (1 << bits) - 1) + 1,
+        size=(p.n_nodes, p.n_changes, p.nseq_max),
+    ).astype(np.int8)
+    words = np.asarray(pack.pack_budget(jnp.asarray(bud), p))
+    assert words.shape == (p.n_nodes, pack.budget_words(p))
+    for n in range(p.n_nodes):
+        assert words[n].tolist() == pack.py_pack_budget_row(bud[n], p)
+        assert pack.py_unpack_budget_row(words[n], p) == bud[n].tolist()
+    back = np.asarray(pack.unpack_budget(jnp.asarray(words), p))
+    assert (back == bud).all()
+
+
+def test_lane_algebra_properties():
+    """lane_nonzero / lane_fill / popcount32 against brute force."""
+    rng = np.random.default_rng(0)
+    words = jnp.asarray(rng.integers(0, 1 << 32, size=256, dtype=np.uint64)
+                        .astype(np.uint32))
+    for bits in (1, 2, 4, 8):
+        nz = np.asarray(pack.lane_nonzero(words, bits))
+        mask = (1 << bits) - 1
+        for w, got in zip(np.asarray(words).tolist(), nz.tolist()):
+            expect = 0
+            for i in range(0, 32, bits):
+                if (w >> i) & mask:
+                    expect |= 1 << i
+            assert got == expect
+        lsb = nz  # lane-LSB flags are valid lane_fill input
+        filled = np.asarray(pack.lane_fill(jnp.asarray(lsb), bits))
+        for f, got in zip(lsb.tolist(), filled.tolist()):
+            assert got == f * mask
+    pc = np.asarray(pack.popcount32(words))
+    assert pc.tolist() == [bin(int(w)).count("1") for w in np.asarray(words)]
+
+
+def test_cov_words_to_chunk_flags_matches_scalar():
+    p = _layout_params(4, 2)
+    rng = np.random.default_rng(1)
+    cov = rng.integers(0, 1 << pack.lane_bits(p),
+                       size=(p.n_nodes, p.n_changes)).astype(np.uint8)
+    words = pack.pack_cov(jnp.asarray(cov), p)
+    flags_w = np.asarray(pack.cov_words_to_chunk_flags(words, p))
+    # scalar: flag (k, s) == chunk bit s of changeset k
+    expect_flags = [
+        [[(int(cov[n, k]) >> s) & 1 for s in range(p.nseq_max)]
+         for k in range(p.n_changes)]
+        for n in range(p.n_nodes)
+    ]
+    for n in range(p.n_nodes):
+        assert flags_w[n].tolist() == pack.py_pack_budget_row(
+            expect_flags[n], p
+        )
+
+
+# -- packed hot path: fidelity + mid-flight equality ------------------------
+
+
+@pytest.mark.parametrize("name", list(packed_configs()))
+def test_packed_matches_reference_exactly(name):
+    """All five BASELINE configs at n=128, packed: exact round counts vs
+    the unpacked CPU reference oracle."""
+    p = packed_configs()[name]
+    ref = reference.run_reference(p.with_(packed=False))
+    res = cluster.run(p)
+    assert res.converged, f"{name}: packed sim did not converge"
+    assert res.rounds == ref.rounds, (
+        f"{name}: packed rounds diverged jax={res.rounds} ref={ref.rounds}"
+    )
+
+
+def test_packed_full_state_equality_mid_flight():
+    """Stronger than round counts: stepping packed and unpacked side by
+    side, unpacking the word planes reproduces the uint8/int8 planes
+    exactly — cov, budget, status, since, round — at a pre-convergence
+    round AND at convergence."""
+    pp = packed_configs()["config4_churn"]
+    pu = pp.with_(packed=False)
+    ref_rounds = cluster.run(pu).rounds
+    step_p = jax.jit(cluster.make_step(pp))
+    step_u = jax.jit(cluster.make_step(pu))
+    sp, su = cluster.init_state(pp), cluster.init_state(pu)
+    probes = {max(1, ref_rounds // 2), ref_rounds}
+    for r in range(1, ref_rounds + 1):
+        sp, su = step_p(sp), step_u(su)
+        if r in probes:
+            cov = np.asarray(pack.unpack_cov(sp[0], pp))
+            bud = np.asarray(pack.unpack_budget(sp[1], pp))
+            assert (cov == np.asarray(su[0])).all(), f"cov diverged @r{r}"
+            assert (bud == np.asarray(su[1])).all(), f"budget diverged @r{r}"
+            assert (np.asarray(sp[2]) == np.asarray(su[2])).all()
+            assert (np.asarray(sp[3]) == np.asarray(su[3])).all()
+            assert int(sp[4]) == int(su[4]) == r
+
+
+def test_packed_run_trace_counts_match_unpacked():
+    pp = packed_configs()["config3_powerlaw"]
+    tp = cluster.run_trace(pp, n_rounds=12)
+    tu = cluster.run_trace(pp.with_(packed=False), n_rounds=12)
+    assert tp.coverage == tu.coverage
+
+
+# -- the memory claim (no 1M allocation: eval_shape only) -------------------
+
+
+def test_live_state_reduction_at_1m_nodes():
+    """config 4 at 1M nodes: packed live state must be >= 3× smaller than
+    the unpacked layout (ISSUE 3 acceptance bar; measured ~5.1×)."""
+    p1m = model.config4_churn100k(seed=0).with_(n_nodes=1_000_000)
+    unpacked = profile.live_state_bytes(p1m.with_(packed=False))
+    packed = profile.live_state_bytes(p1m.with_(packed=True))
+    assert unpacked > 1e9, "unpacked 1M live state should exceed 1 GB"
+    assert unpacked / packed >= 3.0, (
+        f"packed 1M live state only {unpacked / packed:.2f}× smaller"
+    )
+    # plane-level sanity: cov and budget are the planes that shrink
+    pb_u = profile.plane_bytes(p1m.with_(packed=False))
+    pb_p = profile.plane_bytes(p1m.with_(packed=True))
+    assert pb_p["cov"] < pb_u["cov"]
+    assert pb_p["budget"] < pb_u["budget"]
+    assert pb_p["status"] == pb_u["status"]
+
+
+def test_roofline_markdown_generation():
+    """The BENCHMARKS.md section renders from bench JSON lines with the
+    generated-markers and one table row per config line."""
+    lines = [
+        {
+            "metric": "sim_100000n_config4_convergence_wall",
+            "device": "tpu", "rounds": 40, "warm_execute_s": 1.0,
+            "hbm_bytes_per_round": 2.5e8, "achieved_gbps": 500.0,
+            "peak_gbps": 1640.0, "peak_basis": "spec:v6e",
+            "hbm_utilization": 0.3, "live_state_bytes": 2 * 10**7,
+            "live_state_bytes_unpacked": 10**8,
+        }
+    ]
+    md = profile.roofline_markdown(lines)
+    assert md.startswith(profile.BEGIN_MARK)
+    assert md.rstrip().endswith(profile.END_MARK)
+    assert "100000n_config4" in md
+    assert "spec:v6e" in md
+    # vs-r05 column compares against the recorded round-5 warm time
+    assert f"{2.592 / 1.0:.2f}×" in md
+    assert "Verdict" in md

@@ -46,7 +46,7 @@ def _cache_entries(cache_dir: str) -> int:
 def run_config(
     n: int, seed: int, scale: float, dev, cache_dir: str, packed: bool = True
 ) -> dict:
-    from corrosion_tpu.sim import cluster, crdt, model, profile, reference
+    from corrosion_tpu.sim import cluster, crdt, flight, model, profile, reference
 
     p = model.CONFIGS[n](seed=seed).with_(packed=packed)
     if scale != 1.0:
@@ -131,6 +131,21 @@ def run_config(
         f"{prof.hbm_utilization * 100:.0f}% of peak ({prof.peak_basis})"
     )
 
+    # flight record at the measured horizon (the bounded scan doesn't
+    # idle to max_rounds); non-perturbation means its round count MUST
+    # match the while_loop's — a cheap end-to-end recorder check on
+    # every bench run
+    fres = flight.record_run(p, n_rounds=res.rounds)
+    assert fres.rounds == res.rounds and fres.converged == res.converged, (
+        f"flight recorder perturbed the run: {fres.rounds} vs {res.rounds}"
+    )
+    flight.publish_metrics(fres.flight)
+    fsum = flight.summarize(fres.flight)
+    log(
+        f"flight: r50={fsum['r50']} r90={fsum['r90']} r99={fsum['r99']} "
+        f"sha256={fsum['flight_sha256'][:16]}"
+    )
+
     total = res.compile_s + res.wall_s
     out = {
         "metric": f"sim_{p.n_nodes}n_config{n}_convergence_wall",
@@ -147,6 +162,13 @@ def run_config(
         "device": dev.platform,
     }
     out.update(profile.bench_fields(prof))
+    # convergence-curve fields (BENCHMARKS.md convergence section is
+    # generated from these — never hand-edited)
+    out["r50"] = fsum["r50"]
+    out["r90"] = fsum["r90"]
+    out["r99"] = fsum["r99"]
+    out["flight_sha256"] = fsum["flight_sha256"]
+    out["curve"] = [round(c, 4) for c in fres.flight.coverage()]
     return out
 
 

@@ -287,6 +287,7 @@ class Node:
                 endpoint=self.config.telemetry.otlp_endpoint,
                 file_path=self.config.telemetry.otlp_file,
                 extra_attrs={"corrosion.actor": self.agent.actor_id.as_simple()},
+                timeout=self.config.telemetry.otlp_timeout,
             ).start()
 
         if self.config.telemetry.prometheus_addr:

@@ -198,5 +198,16 @@ class BroadcastRuntime:
                 sends.extend(
                     (m.addr, payload) for m in self._initial_targets(payload)
                 )
-        sends.extend(self._resend_tick(prior))
+        # counters increment at collection — before the driver applies
+        # fault-injection drops — so the series matches the async path's
+        # transport-call accounting and the sim's send-before-gating
+        # definition (sim/cluster.py telemetry)
+        from ..utils.metrics import counter
+
+        if sends:
+            counter("corro.broadcast.sent").inc(len(sends))
+        resends = self._resend_tick(prior)
+        if resends:
+            counter("corro.broadcast.resent").inc(len(resends))
+        sends.extend(resends)
         return sends

@@ -73,11 +73,27 @@ _ids = itertools.count(1)
 @dataclass(frozen=True)
 class HarnessRun:
     """One harness replay: rounds to convergence (None = did not
-    converge within the horizon) plus the determinism digests."""
+    converge within the horizon) plus the determinism digests and the
+    per-round telemetry series (metrics-registry counter deltas
+    snapshotted at each round barrier, plus the live-node up-member
+    sum)."""
 
     rounds: Optional[int]
     ledger_digest: str
     membership_digest: str
+    series: Optional[Dict[str, List[int]]] = None
+
+
+# runtime counter-delta series ↔ sim flight-record series pairing used
+# by CompareResult.series_gap: (label, runtime keys summed, sim keys
+# summed).  bcast pairs fresh fanout + resend ticks against the sim's
+# send-before-gating count; sync pairs pulled changeset rows (one row
+# per chunk under the harness's single-column schema) against the sim's
+# pulled-chunk count.
+_SERIES_PAIRS = (
+    ("bcast", ("bcast_sent", "bcast_resent"), ("bcast_sends",)),
+    ("sync", ("sync_recv",), ("sync_chunks",)),
+)
 
 
 @dataclass(frozen=True)
@@ -87,6 +103,8 @@ class CompareResult:
     sim_rounds: Optional[int]
     ledger_digest: str
     membership_digest: str
+    series_runtime: Optional[Dict[str, List[int]]] = None
+    series_sim: Optional[Dict[str, List[int]]] = None
 
     @property
     def gap(self) -> Optional[float]:
@@ -96,6 +114,36 @@ class CompareResult:
             return None
         return abs(self.harness_rounds - self.sim_rounds) / self.sim_rounds
 
+    @property
+    def series_gap(self) -> Optional[Dict[str, float]]:
+        """Per-series cumulative relative gap, |Σruntime − Σsim| /
+        max(1, Σsim), for each ``_SERIES_PAIRS`` entry.  Cumulative —
+        not per-round — because the two legs may shift a send by a
+        round (resend ticks straddle the barrier) while agreeing on
+        totals; the acceptance bar is ±2% on these."""
+        if self.series_runtime is None or self.series_sim is None:
+            return None
+        out: Dict[str, float] = {}
+        for label, rt_keys, sim_keys in _SERIES_PAIRS:
+            rt = sum(sum(self.series_runtime.get(k, ())) for k in rt_keys)
+            sm = sum(sum(self.series_sim.get(k, ())) for k in sim_keys)
+            out[label] = abs(rt - sm) / max(1, sm)
+        return out
+
+    @property
+    def members_up_equal(self) -> Optional[bool]:
+        """True when the per-round believed-up member-count series
+        (runtime: Σ len(up_members()) over live nodes; sim:
+        ``members_up`` in model.TELEMETRY_FIELDS) are EXACTLY equal,
+        round for round.  Membership is discrete protocol state — any
+        divergence is a pairing bug, not noise — so no tolerance."""
+        if self.series_runtime is None or self.series_sim is None:
+            return None
+        return (
+            self.series_runtime.get("members_up")
+            == self.series_sim.get("members_up")
+        )
+
     def to_dict(self) -> dict:
         return {
             "schedule_hash": self.schedule_hash,
@@ -104,6 +152,10 @@ class CompareResult:
             "gap": self.gap,
             "ledger_digest": self.ledger_digest,
             "membership_digest": self.membership_digest,
+            "series_runtime": self.series_runtime,
+            "series_sim": self.series_sim,
+            "series_gap": self.series_gap,
+            "members_up_equal": self.members_up_equal,
         }
 
 
@@ -188,6 +240,7 @@ async def harness_run(
     # deferred: the comparator is importable without a bootable runtime
     from ..agent.agent import make_broadcastable_changes
     from ..harness import DevCluster
+    from ..utils.metrics import counter_snapshot, snapshot_delta
 
     check_harness_runnable(schedule)
     if p is None:
@@ -204,8 +257,9 @@ async def harness_run(
         "probe_period": 1.0,
         "probe_timeout": PROBE_TIMEOUT,
         # suspect at ~+0.7 in its round; DOWN on the round boundary
-        # SUSPICION_ROUNDS later (harness/swim_phase)
-        "suspicion_timeout": SUSPICION_ROUNDS - 0.7,
+        # p.swim_suspicion_rounds later (harness/swim_phase; defaults
+        # to pairing.SUSPICION_ROUNDS via params_for)
+        "suspicion_timeout": p.swim_suspicion_rounds - 0.7,
         # periodic-gossip feeds would consume the seeded swim rng and
         # re-roll the validated draw streams
         "feed_every_acks": 0,
@@ -246,12 +300,51 @@ async def harness_run(
     # and a digest over them would differ between byte-identical runs
     name_of_port = {cluster._ports[nm]: nm for nm in names}
 
+    # per-round runtime telemetry: counter deltas between round barriers
+    # (the registry is process-global, so deltas — not absolutes — keep
+    # the series independent of whatever ran before in this process)
+    series: Dict[str, List[int]] = {
+        "bcast_sent": [],
+        "bcast_resent": [],
+        "sync_recv": [],
+        "swim_events": [],
+        "members_up": [],
+    }
+    snap = counter_snapshot("corro.")
+
     def record_round(r: int) -> None:
+        nonlocal snap
         ledger.update(
             (
                 f"{r}:{cluster._dgram_exp}:{cluster._dgram_got}:"
                 f"{cluster._uni_exp}:{cluster._uni_got}\n"
             ).encode()
+        )
+        now = counter_snapshot("corro.")
+        delta = snapshot_delta(snap, now)
+        snap = now
+        series["bcast_sent"].append(int(delta.get("corro.broadcast.sent", 0)))
+        series["bcast_resent"].append(
+            int(delta.get("corro.broadcast.resent", 0))
+        )
+        # the client-side pull counter is the one the manual-paced sync
+        # path increments; the server-side apply counter is summed in
+        # for parity with deployments that report either
+        series["sync_recv"].append(
+            int(
+                delta.get("corro.sync.client.changes.recv", 0)
+                + delta.get("corro.sync.changes.recv", 0)
+            )
+        )
+        series["swim_events"].append(int(delta.get("corro.swim.events", 0)))
+        # believed-up member count over LIVE nodes only — the sim twin
+        # (members_up in model.TELEMETRY_FIELDS) sums status != DOWN
+        # over its alive mask the same way
+        series["members_up"].append(
+            sum(
+                len(node.members.up_members())
+                for node in cluster.nodes.values()
+            )
         )
         for name in names:
             node = cluster.nodes.get(name)
@@ -325,6 +418,7 @@ async def harness_run(
         rounds=rounds,
         ledger_digest=ledger.hexdigest(),
         membership_digest=membership.hexdigest(),
+        series=series,
     )
 
 
@@ -350,17 +444,26 @@ def sim_rounds(
 async def compare(
     schedule: ChaosSchedule, p: Optional[SimParams] = None
 ) -> CompareResult:
-    """Run both legs and report rounds + gap + determinism digests."""
+    """Run both legs and report rounds + gap + determinism digests +
+    per-round telemetry series for each leg (the sim leg records a
+    flight record, the harness leg snapshots counter deltas at every
+    round barrier — doc/ops.md explains how to read the output)."""
+    from ..sim.reference import run_reference
+
     if p is None:
         p = params_for(schedule)
     lowered = lower(schedule, horizon=p.max_rounds)
     lowered.require_sim_lowerable()
     hr = await harness_run(schedule, p, lowered)
-    sr = sim_rounds(schedule, p, lowered)
+    res = run_reference(p, chaos=lowered, record=True)
     return CompareResult(
         schedule_hash=schedule.schedule_hash(),
         harness_rounds=hr.rounds,
-        sim_rounds=sr,
+        sim_rounds=res.rounds if res.converged else None,
         ledger_digest=hr.ledger_digest,
         membership_digest=hr.membership_digest,
+        series_runtime=hr.series,
+        series_sim=(
+            dict(res.flight.series) if res.flight is not None else None
+        ),
     )

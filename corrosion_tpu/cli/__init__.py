@@ -492,6 +492,39 @@ async def cmd_chaos(args) -> int:
     return 2
 
 
+async def cmd_sim(args) -> int:
+    """``sim trace`` — run (or summarize) a flight-recorded sim run
+    (doc/simulator.md "Flight recorder").  Needs no config file: the
+    simulator is self-contained."""
+    import json as _json
+
+    from ..sim import flight
+
+    if args.sim_cmd == "trace":
+        if args.load:
+            with open(args.load, "r", encoding="utf-8") as f:
+                rec = flight.from_ndjson(f.read())
+            print(_json.dumps(flight.summarize(rec), sort_keys=True, indent=2))
+            return 0
+        from ..sim.model import CONFIGS
+
+        p = CONFIGS[args.baseline](seed=args.seed)
+        if args.scale != 1.0:
+            p = p.with_(n_nodes=max(8, int(p.n_nodes * args.scale)))
+        p = p.with_(packed=not args.unpacked)
+        res = flight.record_run(p, n_rounds=args.rounds)
+        flight.publish_metrics(res.flight)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(flight.to_ndjson(res.flight))
+            print(f"wrote {args.out}", file=sys.stderr)
+        print(_json.dumps(flight.summarize(res.flight), sort_keys=True, indent=2))
+        return 0 if res.converged else 1
+
+    _die(f"unknown sim subcommand {args.sim_cmd!r}")
+    return 2
+
+
 def _cell_str(cell: Any) -> str:
     if cell is None:
         return ""
@@ -681,6 +714,37 @@ def build_parser() -> argparse.ArgumentParser:
         help="max |harness-sim|/sim round gap for exit 0 (default 0.02)",
     )
     sp.set_defaults(fn=cmd_chaos)
+
+    sp = sub.add_parser(
+        "sim",
+        help="TPU-model simulator tools (flight recorder)",
+    )
+    smsub = sp.add_subparsers(dest="sim_cmd", required=True)
+    tr = smsub.add_parser(
+        "trace",
+        help="record a run's per-round telemetry (or summarize a saved "
+        "NDJSON artifact with --load)",
+    )
+    tr.add_argument(
+        "--baseline",
+        type=int,
+        default=1,
+        choices=(1, 2, 3, 4, 5),
+        help="BASELINE config number (sim/model.py CONFIGS)",
+    )
+    tr.add_argument("--scale", type=float, default=1.0,
+                    help="scale n_nodes by this factor (min 8)")
+    tr.add_argument("--seed", type=int, default=0)
+    tr.add_argument("--unpacked", action="store_true",
+                    help="run the unpacked hot path (packed is default)")
+    tr.add_argument("--rounds", type=int, default=None,
+                    help="scan horizon (default: the config's max_rounds)")
+    tr.add_argument("-o", "--out", default=None,
+                    help="write the canonical NDJSON artifact here")
+    tr.add_argument("--load", default=None,
+                    help="summarize an existing NDJSON artifact instead "
+                    "of running")
+    sp.set_defaults(fn=cmd_sim)
 
     sp = sub.add_parser("tls", help="certificate generation")
     tsub = sp.add_subparsers(dest="tls_cmd", required=True)

@@ -89,6 +89,7 @@ class SimResult:
     compile_s: float = 0.0
     coverage: List[float] = field(default_factory=list)
     state: Optional[SimState] = None  # final state if requested
+    flight: Optional[object] = None  # FlightRecord when run(record=True)
 
 
 def _consts(p: SimParams):
@@ -149,8 +150,18 @@ def complete_flags_packed(cov_words: jnp.ndarray, p: SimParams) -> jnp.ndarray:
     return jnp.asarray(pack.valid_lane_mask(p))[None, :] & ~not_complete
 
 
-def make_step(p: SimParams, chaos=None):
+def make_step(p: SimParams, chaos=None, telemetry: bool = False):
     """Build the jittable one-round transition for params ``p``.
+
+    With ``telemetry=True`` the returned step yields
+    ``(state, {field: int32 scalar})`` over :data:`TELEMETRY_FIELDS` —
+    the flight recorder's per-round observables, computed from the
+    phase intermediates the step already materializes (word-space SWAR
+    popcounts on the packed planes, sim/pack.py; no unpacked
+    temporaries beyond the transients the phases themselves use).  The
+    counter-based RNG consumes no state, so the extra reductions cannot
+    perturb the trajectory; tests/test_sim_flight.py asserts
+    bit-identical rounds and state against ``telemetry=False``.
 
     ``chaos`` is an optional :class:`corrosion_tpu.chaos.LoweredChaos`:
     an explicit fault schedule compiled to dense per-round tensors.
@@ -210,6 +221,7 @@ def make_step(p: SimParams, chaos=None):
         ks_shift = (ks % lanes_b).astype(jnp.uint32) * jnp.uint32(bb)
         ks_k = ks // S
         T32 = jnp.uint32(p.max_transmissions)
+        valid_w = jnp.asarray(pack.valid_lane_mask(p))
 
     def death(x):
         """bool[N]: churn death draw hit at round x (x may be negative)."""
@@ -568,6 +580,11 @@ def make_step(p: SimParams, chaos=None):
             covu = cov
         delivered = jnp.zeros((N, K), dtype=jnp.uint8)
         kk = jnp.broadcast_to(kvec, (N, K))
+        if telemetry:
+            # sends = payloads dispatched to a FOUND (believed-up) target,
+            # before delivery gating — what the runtime's
+            # corro.broadcast.sent/resent count at the send call site
+            tel_bcast = jnp.int32(0)
         for s in range(S):
             bit = jnp.uint8(1 << s)
             plane = jnp.zeros((N, K), dtype=bool)
@@ -589,6 +606,10 @@ def make_step(p: SimParams, chaos=None):
                     )
                     if c_drop is not None:
                         ok = jnp.logical_and(ok, link_up(nvec, t))
+                    if telemetry:
+                        tel_bcast = tel_bcast + jnp.logical_and(
+                            hold, found
+                        ).sum(dtype=jnp.int32)
                     plane = plane.at[t, kk].max(hold & ok)
                     chosen.append(t)
             else:
@@ -604,6 +625,10 @@ def make_step(p: SimParams, chaos=None):
                     )
                     if c_drop is not None:
                         ok = jnp.logical_and(ok, link_up(narange, t))
+                    if telemetry:
+                        tel_bcast = tel_bcast + jnp.logical_and(
+                            hold, found[:, None]
+                        ).sum(dtype=jnp.int32)
                     plane = plane.at[t].max(hold & ok[:, None])
             delivered = delivered | jnp.where(plane, bit, jnp.uint8(0))
 
@@ -615,6 +640,8 @@ def make_step(p: SimParams, chaos=None):
             new_w = delivered_w & ~cov
             new_w = jnp.where(alive[:, None], new_w, jnp.uint32(0))
             cov = cov | new_w
+            if telemetry:
+                tel_deliv = pack.popcount32(new_w).sum()
             # budget-layout lane-LSB flags of the newly landed chunks
             new_f = pack.cov_words_to_chunk_flags(new_w, p)
             pend_f = jnp.where(alive[:, None], pend_lsb, jnp.uint32(0))
@@ -627,6 +654,8 @@ def make_step(p: SimParams, chaos=None):
             new_bits = delivered & ~cov
             new_bits = jnp.where(alive[:, None], new_bits, 0)
             cov = cov | new_bits
+            if telemetry:
+                tel_deliv = pack.popcount32(new_bits.astype(jnp.uint32)).sum()
             chunk_bits = jnp.asarray(
                 [1 << s for s in range(S)], dtype=jnp.uint8
             )
@@ -640,6 +669,9 @@ def make_step(p: SimParams, chaos=None):
             )
 
         # 5. anti-entropy: budgeted needs-based pull from one peer
+        if telemetry:
+            tel_sync_sess = jnp.int32(0)
+            tel_sync_chunks = jnp.int32(0)
         if p.sync_interval > 0:
 
             def sync_draw(a: int):
@@ -695,7 +727,26 @@ def make_step(p: SimParams, chaos=None):
                 return jnp.where(okq[:, None], c | pulled, c)
 
             due = (r + 1) % p.sync_interval == 0
-            cov = lax.cond(due, sync_pull, lambda c: c, cov)
+            if telemetry:
+                # widen the cond's carry with (sessions, chunks pulled) so
+                # the stats ride OUT of the gated branch; the off-round
+                # branch returns matching zeros, and the record=False
+                # build above keeps the original single-output cond
+                def sync_pull_tel(c):
+                    c2 = sync_pull(c)
+                    delta = c2 ^ c
+                    if not p.packed:
+                        delta = delta.astype(jnp.uint32)
+                    return c2, okq.sum(dtype=jnp.int32), pack.popcount32(delta).sum()
+
+                cov, tel_sync_sess, tel_sync_chunks = lax.cond(
+                    due,
+                    sync_pull_tel,
+                    lambda c: (c, jnp.int32(0), jnp.int32(0)),
+                    cov,
+                )
+            else:
+                cov = lax.cond(due, sync_pull, lambda c: c, cov)
 
         # 6. churn: deaths wipe to own writes (replacement node
         # re-registering); the node stays unresponsive for D rounds.
@@ -731,7 +782,63 @@ def make_step(p: SimParams, chaos=None):
                     jnp.where(own_now[:, :, None], T8, jnp.int8(0)),
                     budget,
                 )
-        return cov, budget, status, since, r + 1
+        if not telemetry:
+            return cov, budget, status, since, r + 1
+
+        # 7. flight-recorder reductions on the POST-round planes (word
+        # space when packed); defined to match what the runtime's counters
+        # observe at a DevCluster round barrier (chaos/compare.py parity)
+        if p.packed:
+            notc = pack.lane_nonzero(cov ^ full_w[None, :], cb)
+            cflags = valid_w[None, :] & ~notc
+            complete_pairs = pack.popcount32(cflags).sum()
+            nodes_complete = jnp.sum(
+                jnp.all(cflags == valid_w[None, :], axis=1), dtype=jnp.int32
+            )
+            budget_remaining = pack.lane_sum(budget, bb).sum()
+        else:
+            cmask = cov == full[None, :]
+            complete_pairs = jnp.sum(cmask, dtype=jnp.int32)
+            nodes_complete = jnp.sum(
+                jnp.all(cmask, axis=1), dtype=jnp.int32
+            )
+            budget_remaining = jnp.sum(budget, dtype=jnp.int32)
+        # members_up: the sim twin of summing len(up_members()) over live
+        # runtime nodes — others not believed DOWN, through each live
+        # node's own view row (per-node) or its side's consensus view
+        not_down = status != DOWN
+        if per_node:
+            cnt = jnp.sum(not_down, axis=1, dtype=jnp.int32) - not_down[
+                narange, narange
+            ].astype(jnp.int32)
+            members_up = jnp.sum(jnp.where(alive, cnt, 0))
+        else:
+            side = part.astype(jnp.int32)
+            cnt = jnp.sum(not_down, axis=1, dtype=jnp.int32)
+            self_nd = not_down[side, narange].astype(jnp.int32)
+            members_up = jnp.sum(jnp.where(alive, cnt[side] - self_nd, 0))
+        if p.swim:
+            probe_sends = jnp.sum(probing, dtype=jnp.int32)
+        else:
+            probe_sends = jnp.int32(0)
+        tel = {
+            "probe_sends": probe_sends,
+            "bcast_sends": tel_bcast,
+            "deliveries": tel_deliv,
+            "sync_sessions": tel_sync_sess,
+            "sync_chunks": tel_sync_chunks,
+            "complete_pairs": complete_pairs,
+            "nodes_complete": nodes_complete,
+            "budget_remaining": budget_remaining,
+            "members_up": members_up,
+            "views_up": jnp.sum(status == ALIVE, dtype=jnp.int32),
+            "views_suspect": jnp.sum(status == SUSPECT, dtype=jnp.int32),
+            "views_down": jnp.sum(status == DOWN, dtype=jnp.int32),
+            "n_alive": jnp.sum(alive, dtype=jnp.int32),
+            "n_restarted": jnp.sum(restarted, dtype=jnp.int32),
+            "part_active": jnp.asarray(part_active).astype(jnp.int32),
+        }
+        return (cov, budget, status, since, r + 1), tel
 
     return step
 
@@ -802,13 +909,30 @@ def run(
     change_axis: Optional[str] = None,
     return_state: bool = False,
     chaos=None,
+    record: bool = False,
 ) -> SimResult:
     """Run to convergence (or max_rounds); returns timing split into
     compile and execute so the <60 s north star is measured on execute+
     compile both (BASELINE.md reports wall-clock).  ``chaos`` threads an
     explicit fault schedule into the step (see :func:`make_step`);
     ``change_axis`` names a second mesh dimension to shard the
-    changeset/word axis over (2-D GSPMD, see :func:`state_shardings`)."""
+    changeset/word axis over (2-D GSPMD, see :func:`state_shardings`).
+
+    ``record=True`` switches to the flight recorder (sim/flight.py): a
+    bounded ``lax.scan`` over the SAME step stacks one
+    :data:`TELEMETRY_FIELDS` scalar tuple per round, and the returned
+    ``SimResult.flight`` carries the per-round series.  Recording is
+    non-perturbing — bit-identical rounds and final state to
+    ``record=False`` (tests/test_sim_flight.py) — but scans all
+    ``p.max_rounds`` rounds, so it costs wall-clock past convergence."""
+    if record:
+        from . import flight
+
+        assert mesh is None, (
+            "flight recording is a single-host analysis mode; run the "
+            "sharded production loop with record=False"
+        )
+        return flight.record_run(p, chaos=chaos, return_state=return_state)
     if chaos is not None:
         assert chaos.horizon >= p.max_rounds, (
             "lower(sched, horizon=p.max_rounds) so round gathers stay "

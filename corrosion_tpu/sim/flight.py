@@ -1,0 +1,439 @@
+"""Flight recorder: per-round telemetry from inside the sim's hot loop.
+
+The production loop (sim/cluster.py ``run``) is a ``lax.while_loop`` that
+discards everything between round 0 and convergence — the only
+observable is the final round count, while the runtime exports ~50
+documented series (doc/telemetry.md).  ``record_run`` switches the SAME
+one-round step to a bounded ``lax.scan`` and stacks one
+:data:`~corrosion_tpu.sim.model.TELEMETRY_FIELDS` int32 scalar per
+round: message sends by kind (probe / broadcast / sync-pull), chunk
+deliveries, completion counts, remaining retransmission budget,
+membership view tallies and active chaos faults.  The reductions run in
+word space on the packed planes (SWAR popcounts / lane sums,
+sim/pack.py) and consume no RNG state, so recording is **non-perturbing**:
+round counts and final state are bit-identical to ``record=False``
+(tests/test_sim_flight.py asserts this on all five BASELINE configs,
+packed and unpacked).
+
+Consumers:
+
+- NDJSON artifact (:func:`to_ndjson`): a sorted-key header line plus one
+  object per round — byte-deterministic for a given (params, seed,
+  schedule), so artifacts diff and hash cleanly (:func:`record_hash`).
+  ``save_npz`` also writes the stacked planes for numpy consumers, but
+  zip member timestamps make npz bytes non-reproducible; the NDJSON is
+  the canonical artifact and the only one the determinism contract
+  covers.
+- ``corro.sim.round.*`` gauges (:func:`publish_metrics`,
+  doc/telemetry.md) with a ``nodes`` label, like the roofline series.
+- convergence summaries (:func:`summarize`: rounds to 50/90/99%
+  nodes-complete) folded into every bench.py JSON line, and a
+  marker-delimited BENCHMARKS.md convergence section
+  (``python -m corrosion_tpu.sim.flight --update-benchmarks``).
+- the sim leg of the runtime-parity comparison (chaos/compare.py):
+  the reference executor records the same fields scalar-side
+  (sim/reference.py ``record=True``) and the per-round series are
+  compared against metrics-registry counter deltas taken at DevCluster
+  round barriers.
+
+Memory: the scan stacks ``len(TELEMETRY_FIELDS)`` int32 scalars for
+``n_rounds`` rounds — 60 bytes/round, ~15 KB at max_rounds=256 —
+regardless of ``SimParams.packed`` or cluster size; the state planes
+themselves ride the scan carry exactly as in the while_loop, so peak
+live state matches the production loop (doc/simulator.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import math
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import cluster
+from .cluster import SimResult
+from .model import CONFIGS, TELEMETRY_FIELDS, SimParams
+
+
+@dataclass
+class FlightRecord:
+    """One recorded run: run identity + per-round int series.
+
+    ``series`` maps every :data:`TELEMETRY_FIELDS` name to a list of
+    ``rounds`` ints (the scan's post-convergence zero rows are
+    truncated).  ``max_rounds`` is the scanned horizon the record was
+    bounded by; ``rounds`` ≤ ``max_rounds`` is the convergence round
+    (== SimResult.rounds, bit-identical to the while_loop)."""
+
+    n_nodes: int
+    n_changes: int
+    nseq_max: int
+    seed: int
+    packed: bool
+    max_rounds: int
+    rounds: int
+    converged: bool
+    schedule_hash: Optional[str] = None
+    series: Dict[str, List[int]] = field(default_factory=dict)
+
+    def coverage(self) -> List[float]:
+        """Per-round complete-pair fraction in [0, 1]."""
+        total = self.n_nodes * self.n_changes
+        return [c / total for c in self.series["complete_pairs"]]
+
+
+def record_run(
+    p: SimParams,
+    chaos=None,
+    n_rounds: Optional[int] = None,
+    return_state: bool = False,
+) -> SimResult:
+    """Run ``p`` under the flight recorder; ``SimResult.flight`` carries
+    the :class:`FlightRecord`.
+
+    The scan body gates the step on the convergence predicate the
+    while_loop uses: once every node holds every chunk, the remaining
+    iterations pass state through unchanged (zero telemetry), so the
+    final carry — round counter included — is bit-identical to the
+    ``record=False`` exit.  ``n_rounds`` bounds the scan (default
+    ``p.max_rounds``; bench.py passes the measured convergence round so
+    large configs don't idle to the horizon)."""
+    n_rounds = p.max_rounds if n_rounds is None else n_rounds
+    if chaos is not None:
+        assert chaos.horizon >= n_rounds, (
+            "lower(sched, horizon=n_rounds) so round gathers stay in "
+            "bounds (XLA clamps out-of-range indices silently)"
+        )
+    step = cluster.make_step(p, chaos=chaos, telemetry=True)
+    full = cluster._full_plane(p)
+    zeros = {f: jnp.int32(0) for f in TELEMETRY_FIELDS}
+
+    def body(state, _):
+        done = (state[0] == full[None, :]).all()
+        return lax.cond(done, lambda s: (s, zeros), step, state)
+
+    t0 = time.perf_counter()
+    fn = jax.jit(lambda s: lax.scan(body, s, None, length=n_rounds))
+    compiled = fn.lower(cluster.init_state(p)).compile()
+    t1 = time.perf_counter()
+    out, tel = jax.block_until_ready(compiled(cluster.init_state(p)))
+    rounds_scanned = int(out[-1])  # scalar fetch: see the axon note in run()
+    t2 = time.perf_counter()
+    converged = bool((out[0] == full[None, :]).all())
+    # the done-gate freezes the round counter at convergence, so the
+    # carried counter IS the while_loop's exit round (or n_rounds)
+    series = {f: [int(v) for v in tel[f]] for f in TELEMETRY_FIELDS}
+    total = p.n_nodes * p.n_changes
+    rounds = rounds_scanned
+    for i, cp in enumerate(series["complete_pairs"]):
+        if cp == total:
+            rounds = i + 1
+            break
+    series = {f: v[:rounds] for f, v in series.items()}
+    rec = FlightRecord(
+        n_nodes=p.n_nodes,
+        n_changes=p.n_changes,
+        nseq_max=p.nseq_max,
+        seed=p.seed,
+        packed=p.packed,
+        max_rounds=n_rounds,
+        rounds=rounds,
+        converged=converged,
+        schedule_hash=(
+            chaos.schedule.schedule_hash() if chaos is not None else None
+        ),
+        series=series,
+    )
+    return SimResult(
+        converged=converged,
+        rounds=rounds,
+        wall_s=t2 - t1,
+        compile_s=t1 - t0,
+        coverage=rec.coverage(),
+        state=tuple(out) if return_state else None,
+        flight=rec,
+    )
+
+
+# -- canonical NDJSON artifact ----------------------------------------------
+
+
+def _dumps(obj: dict) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def to_ndjson(rec: FlightRecord) -> str:
+    """Canonical byte-deterministic artifact: one sorted-key header line,
+    then one object per recorded round."""
+    head = {
+        "flight": 1,
+        "n_nodes": rec.n_nodes,
+        "n_changes": rec.n_changes,
+        "nseq_max": rec.nseq_max,
+        "seed": rec.seed,
+        "packed": rec.packed,
+        "max_rounds": rec.max_rounds,
+        "rounds": rec.rounds,
+        "converged": rec.converged,
+        "schedule_hash": rec.schedule_hash,
+        "fields": list(TELEMETRY_FIELDS),
+    }
+    lines = [_dumps(head)]
+    for i in range(rec.rounds):
+        row = {"round": i}
+        for f in TELEMETRY_FIELDS:
+            row[f] = rec.series[f][i]
+        lines.append(_dumps(row))
+    return "\n".join(lines) + "\n"
+
+
+def from_ndjson(text: str) -> FlightRecord:
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    head = json.loads(lines[0])
+    assert head.get("flight") == 1, "not a flight-record NDJSON artifact"
+    fields = head["fields"]
+    series: Dict[str, List[int]] = {f: [] for f in fields}
+    for ln in lines[1:]:
+        row = json.loads(ln)
+        for f in fields:
+            series[f].append(row[f])
+    return FlightRecord(
+        n_nodes=head["n_nodes"],
+        n_changes=head["n_changes"],
+        nseq_max=head["nseq_max"],
+        seed=head["seed"],
+        packed=head["packed"],
+        max_rounds=head["max_rounds"],
+        rounds=head["rounds"],
+        converged=head["converged"],
+        schedule_hash=head.get("schedule_hash"),
+        series=series,
+    )
+
+
+def record_hash(rec: FlightRecord) -> str:
+    """sha256 of the canonical NDJSON bytes — the identity bench.py
+    stamps so perf PRs can diff trajectories, not just ms/round."""
+    return hashlib.sha256(to_ndjson(rec).encode()).hexdigest()
+
+
+def save_npz(rec: FlightRecord, path: str) -> None:
+    """Stacked planes for numpy consumers.  NOT byte-reproducible (zip
+    member timestamps); hash/diff the NDJSON instead."""
+    import numpy as np
+
+    np.savez(
+        path,
+        meta=np.array(
+            [rec.n_nodes, rec.n_changes, rec.nseq_max, rec.seed,
+             int(rec.packed), rec.max_rounds, rec.rounds,
+             int(rec.converged)],
+            dtype=np.int64,
+        ),
+        **{f: np.asarray(rec.series[f], dtype=np.int32) for f in TELEMETRY_FIELDS},
+    )
+
+
+# -- convergence summaries ---------------------------------------------------
+
+
+def rounds_to_fraction(rec: FlightRecord, frac: float) -> Optional[int]:
+    """First round (1-based) where ≥ ``frac`` of nodes hold every
+    changeset complete; None if the record never gets there."""
+    need = math.ceil(frac * rec.n_nodes)
+    for i, nc in enumerate(rec.series["nodes_complete"]):
+        if nc >= need:
+            return i + 1
+    return None
+
+
+def summarize(rec: FlightRecord) -> Dict[str, object]:
+    """The bench.py / CLI digest of one record: convergence quantiles,
+    cumulative message counts and the artifact hash."""
+    return {
+        "rounds": rec.rounds,
+        "converged": rec.converged,
+        "r50": rounds_to_fraction(rec, 0.50),
+        "r90": rounds_to_fraction(rec, 0.90),
+        "r99": rounds_to_fraction(rec, 0.99),
+        "probe_sends": sum(rec.series["probe_sends"]),
+        "bcast_sends": sum(rec.series["bcast_sends"]),
+        "deliveries": sum(rec.series["deliveries"]),
+        "sync_sessions": sum(rec.series["sync_sessions"]),
+        "sync_chunks": sum(rec.series["sync_chunks"]),
+        "flight_sha256": record_hash(rec),
+    }
+
+
+def publish_metrics(rec: FlightRecord) -> None:
+    """Export the record as ``corro.sim.round.*`` gauges (doc/telemetry.md).
+
+    Like the roofline series, the ``nodes`` label is the simulated
+    cluster size (no ``actor`` label — these describe the simulator, not
+    a cluster node).  Cumulative totals for the flow series, final-round
+    values for the level series, and the convergence quantiles (−1 when
+    the run never reached the fraction)."""
+    from ..utils.metrics import gauge
+
+    lbl = {"nodes": str(rec.n_nodes)}
+    s = rec.series
+    gauge("corro.sim.round.probe.sends", **lbl).set(sum(s["probe_sends"]))
+    gauge("corro.sim.round.bcast.sends", **lbl).set(sum(s["bcast_sends"]))
+    gauge("corro.sim.round.deliveries", **lbl).set(sum(s["deliveries"]))
+    gauge("corro.sim.round.sync.sessions", **lbl).set(sum(s["sync_sessions"]))
+    gauge("corro.sim.round.sync.chunks", **lbl).set(sum(s["sync_chunks"]))
+    gauge("corro.sim.round.nodes.complete", **lbl).set(
+        s["nodes_complete"][-1] if s["nodes_complete"] else 0
+    )
+    gauge("corro.sim.round.budget.remaining", **lbl).set(
+        s["budget_remaining"][-1] if s["budget_remaining"] else 0
+    )
+    gauge("corro.sim.round.members.up", **lbl).set(
+        s["members_up"][-1] if s["members_up"] else 0
+    )
+    quantiles = (
+        (0.50, "corro.sim.round.r50"),
+        (0.90, "corro.sim.round.r90"),
+        (0.99, "corro.sim.round.r99"),
+    )
+    for q, name in quantiles:
+        v = rounds_to_fraction(rec, q)
+        gauge(name, **lbl).set(-1 if v is None else v)
+
+
+# -- BENCHMARKS.md convergence section (generated, never hand-edited) -------
+
+BEGIN_MARK = "<!-- convergence:begin (generated by corrosion_tpu.sim.flight; do not hand-edit) -->"
+END_MARK = "<!-- convergence:end -->"
+
+_SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def sparkline(fracs: List[float], width: int = 40) -> str:
+    """Coverage fractions (0..1) → a fixed-width unicode sparkline."""
+    if not fracs:
+        return ""
+    if len(fracs) > width:
+        idx = [round(i * (len(fracs) - 1) / (width - 1)) for i in range(width)]
+        fracs = [fracs[i] for i in idx]
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1, int(f * (len(_SPARK) - 1) + 1e-9))]
+        for f in fracs
+    )
+
+
+def convergence_markdown(lines: List[dict]) -> str:
+    """Render the convergence section from bench JSON lines (one dict per
+    config, as printed by bench.py)."""
+    out = [
+        BEGIN_MARK,
+        "",
+        "## Convergence curves: rounds to 50/90/99% nodes-complete",
+        "",
+        "Per config: the flight recorder's per-round nodes-complete curve",
+        "(sim/flight.py; sparkline is complete-pair coverage per round,",
+        "left = round 1), the rounds at which 50/90/99% of nodes held",
+        "every changeset, and the sha256 of the canonical NDJSON",
+        "artifact — perf PRs diff these trajectories, not just ms/round.",
+        "`—` quantiles mean the run hit max_rounds first.",
+        "",
+        "| metric | rounds | r50 | r90 | r99 | curve | flight sha256 |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for ln in lines:
+        if "r50" not in ln and "flight_sha256" not in ln:
+            continue
+
+        def q(name):
+            v = ln.get(name)
+            return "—" if v is None else str(v)
+
+        curve = ln.get("curve") or []
+        sha = ln.get("flight_sha256") or "?"
+        out.append(
+            "| {m} | {r} | {r50} | {r90} | {r99} | `{c}` | `{h}` |".format(
+                m=str(ln.get("metric", "?"))
+                .replace("sim_", "")
+                .replace("_convergence_wall", ""),
+                r=ln.get("rounds", "—"),
+                r50=q("r50"),
+                r90=q("r90"),
+                r99=q("r99"),
+                c=sparkline(curve),
+                h=sha[:16],
+            )
+        )
+    out += ["", END_MARK]
+    return "\n".join(out)
+
+
+def update_benchmarks(bench_json_path: str, md_path: str) -> None:
+    """Replace (or append) the marker-delimited convergence section of
+    ``md_path`` from the JSON lines in ``bench_json_path`` — same
+    contract as the roofline section (sim/profile.py)."""
+    lines = []
+    with open(bench_json_path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    lines.append(json.loads(raw))
+                except json.JSONDecodeError:
+                    pass
+    section = convergence_markdown(lines)
+    with open(md_path) as f:
+        doc = f.read()
+    if BEGIN_MARK in doc and END_MARK in doc:
+        head, rest = doc.split(BEGIN_MARK, 1)
+        _, tail = rest.split(END_MARK, 1)
+        doc = head + section + tail
+    else:
+        doc = doc.rstrip("\n") + "\n\n" + section + "\n"
+    with open(md_path, "w") as f:
+        f.write(doc)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", type=int, default=1)
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--unpacked", action="store_true")
+    ap.add_argument("--rounds", type=int, default=None)
+    ap.add_argument("-o", "--out", default=None, help="write NDJSON here")
+    ap.add_argument(
+        "--update-benchmarks",
+        action="store_true",
+        help="regenerate the BENCHMARKS.md convergence section from --bench",
+    )
+    ap.add_argument("--bench", default="BENCH_r07.json")
+    ap.add_argument("--md", default="BENCHMARKS.md")
+    args = ap.parse_args()
+
+    if args.update_benchmarks:
+        update_benchmarks(args.bench, args.md)
+        print(f"updated {args.md} from {args.bench}", file=sys.stderr)
+        return
+
+    p = CONFIGS[args.config](seed=args.seed if args.seed is not None else 0)
+    if args.scale != 1.0:
+        p = p.with_(n_nodes=max(8, int(p.n_nodes * args.scale)))
+    p = p.with_(packed=not args.unpacked)
+    res = record_run(p, n_rounds=args.rounds)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(to_ndjson(res.flight))
+        print(f"wrote {args.out}", file=sys.stderr)
+    print(json.dumps(summarize(res.flight), sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

@@ -105,6 +105,33 @@ COMPLETE, ER, POWERLAW = "complete", "er", "powerlaw"
 # per-member Alive/Suspect/Down, broadcast/mod.rs:162-374)
 ALIVE, SUSPECT, DOWN = 0, 1, 2
 
+# Per-round telemetry scalars the flight recorder stacks (sim/flight.py).
+# Defined here — not in cluster.py — because BOTH executors record them:
+# the JAX step computes each one with word-space reductions
+# (cluster.make_step(telemetry=True)) and the scalar mirror counts the
+# same quantities at the same round phases (reference.run_reference
+# record=True), so the two records compare field by field.  Order is the
+# canonical artifact column order.  All values fit int32 — the binding
+# total is budget_remaining at N·K·S·max_transmissions ≈ 1.5e9 for the
+# 1M-node config 4, inside 2**31.
+TELEMETRY_FIELDS = (
+    "probe_sends",       # SWIM probes dispatched (believed-up target found)
+    "bcast_sends",       # broadcast payload sends, fresh + retransmission
+    "deliveries",        # chunks newly landed at a receiver this round
+    "sync_sessions",     # anti-entropy pull sessions that ran
+    "sync_chunks",       # chunks acquired via anti-entropy this round
+    "complete_pairs",    # (node, changeset) pairs fully assembled
+    "nodes_complete",    # nodes holding every changeset complete
+    "budget_remaining",  # total remaining retransmission budget
+    "members_up",        # Σ over live nodes of others believed up/suspect
+    "views_up",          # ALIVE entries across membership view rows
+    "views_suspect",     # SUSPECT entries across membership view rows
+    "views_down",        # DOWN entries across membership view rows
+    "n_alive",           # ground-truth live nodes
+    "n_restarted",       # replacement nodes booted this round
+    "part_active",       # 1 while a partition cut is active
+)
+
 
 @dataclass(frozen=True)
 class SimParams:

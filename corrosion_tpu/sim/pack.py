@@ -227,6 +227,20 @@ def lane_fill(lsb_bits: jnp.ndarray, bits: int) -> jnp.ndarray:
     return lsb_bits * jnp.uint32((1 << bits) - 1)
 
 
+def lane_sum(words: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """int32 sum of all lane VALUES in each word: Σ_i 2**i · popcount of
+    the i-th bit position across lanes.  Word-space — no unpacked
+    temporaries — so the flight recorder can total remaining budgets
+    straight from the packed plane.  Safe while the true total stays
+    below 2**31 (budget totals cap at N·K·S·max_transmissions; ~1.5e9
+    at the 1M-node BASELINE config 4, inside int32)."""
+    lsb = lane_lsb_mask(bits)
+    acc = jnp.zeros(words.shape, dtype=jnp.int32)
+    for i in range(bits):
+        acc = acc + (popcount32(words & jnp.uint32(lsb << i)) << i)
+    return acc
+
+
 def popcount32(x: jnp.ndarray) -> jnp.ndarray:
     """int32 set-bit counts via the SWAR reduction (pairwise field sums:
     2-bit, then 4-bit, then one multiply-accumulate folds the byte sums

@@ -24,7 +24,16 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional, Set
 
-from .model import ALIVE, COMPLETE, DOWN, ER, POWERLAW, SUSPECT, SimParams
+from .model import (
+    ALIVE,
+    COMPLETE,
+    DOWN,
+    ER,
+    POWERLAW,
+    SUSPECT,
+    TELEMETRY_FIELDS,
+    SimParams,
+)
 from .rng import (
     TAG_BCAST,
     TAG_CHAOS_DROP,
@@ -53,6 +62,11 @@ class RefResult:
     status: List[List[int]] = field(default_factory=list)
     # final per-node retransmission budgets (debugging / state equality)
     budget: List[List[int]] = field(default_factory=list)
+    # sim.flight.FlightRecord when run_reference(record=True): the scalar
+    # executor's per-round telemetry, field-identical to the JAX
+    # recorder's (tests/test_sim_flight.py) — the sim leg chaos/compare.py
+    # holds against the runtime's counter deltas
+    flight: Optional[object] = None
 
 
 def _bcast_target(
@@ -126,14 +140,23 @@ def _sync_peer(p: SimParams, r: int, n: int, a: int) -> int:
 
 
 def run_reference(
-    p: SimParams, max_rounds: Optional[int] = None, chaos=None
+    p: SimParams,
+    max_rounds: Optional[int] = None,
+    chaos=None,
+    record: bool = False,
 ) -> RefResult:
     """Scalar mirror of :func:`corrosion_tpu.sim.cluster.run`.  ``chaos``
     takes the same :class:`corrosion_tpu.chaos.LoweredChaos` as the JAX
     backend: liveness / wipe / restart / partition come from the lowered
     schedule tensors, and link drops consult the same
     ``(schedule.seed, TAG_CHAOS_DROP, round, src, dst)`` draws, so the
-    two backends stay bit-identical under fault injection too."""
+    two backends stay bit-identical under fault injection too.
+
+    ``record=True`` fills ``RefResult.flight`` with the scalar twin of
+    the JAX flight record (model.TELEMETRY_FIELDS, one int per round):
+    sends are counted where a believed-up target was FOUND — before the
+    delivery gates — matching both the JAX recorder and the call sites
+    of the runtime's ``corro.broadcast.sent/resent`` counters."""
     N, K, T, D = p.n_nodes, p.n_changes, p.max_transmissions, p.churn_down_rounds
     max_rounds = p.max_rounds if max_rounds is None else max_rounds
     S = max(1, p.nseq_max)
@@ -220,7 +243,11 @@ def run_reference(
         return first, False
 
     result = RefResult(converged=False, rounds=max_rounds)
+    tel_rounds: List[dict] = []
+    tel: Optional[dict] = None
     for r in range(max_rounds):
+        if record:
+            tel = dict.fromkeys(TELEMETRY_FIELDS, 0)
         if chaos is not None:
             part_active = bool(chaos.part_active[r])
             alive = [not chaos.dead[r][n] for n in range(N)]
@@ -251,6 +278,8 @@ def run_reference(
                     v, lambda a, v=v: _probe_target(p, r, v, a), 0
                 )
                 if found:
+                    if record:
+                        tel["probe_sends"] += 1
                     # a probe crossing an active partition cut fails like
                     # a dead target would (mirrors cluster.py edge_ok)
                     probes[v] = (t, alive[t] and pvec[v] == pvec[t])
@@ -335,6 +364,8 @@ def run_reference(
                 )
                 if not found:
                     continue
+                if record:
+                    tel["probe_sends"] += 1
                 ok = alive[t] and pvec[n] == pvec[t]
                 views = [part[n]] if part_active else [0, 1]
                 for v in views:
@@ -396,6 +427,11 @@ def run_reference(
                                 part[n],
                             )
                             chosen.append(t)
+                            # a FOUND target is a send (counted before
+                            # the delivery gates — the runtime counts at
+                            # the transport call, delivered or not)
+                            if record and found:
+                                tel["bcast_sends"] += 1
                             if (
                                 not found
                                 or pvec[n] != pvec[t]
@@ -415,6 +451,13 @@ def run_reference(
                             ),
                             part[n],
                         )
+                        if record and found:
+                            # every pending payload rides the shared draw
+                            tel["bcast_sends"] += sum(
+                                1
+                                for k in range(K)
+                                if pend[n][k][s] and snap[n][k] & (1 << s)
+                            )
                         if (
                             not found
                             or pvec[n] != pvec[t]
@@ -432,6 +475,8 @@ def run_reference(
         for n in range(N):
             for k in range(K):
                 new = delivered[n][k] & ~cov[n][k] if alive[n] else 0
+                if record:
+                    tel["deliveries"] += bin(new).count("1")
                 cov[n][k] |= new
                 for s in range(S):
                     if new & (1 << s):
@@ -453,11 +498,18 @@ def run_reference(
                 # the whole pull session rides the initiator→peer link
                 if link_dropped(r, n, q):
                     continue
+                if record:
+                    tel["sync_sessions"] += 1
                 heads = syncmod.py_heads(snap[n], aidx, vidx, n_actors)
                 avail = syncmod.py_available(
                     snap[n], snap[q], full, heads, aidx, vidx
                 )
                 pulled = syncmod.py_budget_transfer(avail, p.sync_chunk_budget)
+                if record:
+                    tel["sync_chunks"] += sum(
+                        bin(pulled[k] & ~snap[n][k]).count("1")
+                        for k in range(K)
+                    )
                 for k in range(K):
                     cov[n][k] |= pulled[k]
 
@@ -484,11 +536,67 @@ def run_reference(
             1 for n in range(N) for k in range(K) if cov[n][k] == full[k]
         )
         result.coverage.append(total / float(N * K))
+        if record:
+            # post-round reductions, same planes the JAX recorder reduces
+            tel["complete_pairs"] = total
+            tel["nodes_complete"] = sum(
+                1
+                for n in range(N)
+                if all(cov[n][k] == full[k] for k in range(K))
+            )
+            tel["budget_remaining"] = sum(
+                budget[n][k][s]
+                for n in range(N)
+                for k in range(K)
+                for s in range(S)
+            )
+            if per_node:
+                tel["members_up"] = sum(
+                    sum(1 for m in range(N) if view[v][m] != DOWN)
+                    - (1 if view[v][v] != DOWN else 0)
+                    for v in range(N)
+                    if alive[v]
+                )
+                plane = [st for row in view for st in row]
+            else:
+                tel["members_up"] = sum(
+                    sum(1 for t in range(N) if status[part[n]][t] != DOWN)
+                    - (1 if status[part[n]][n] != DOWN else 0)
+                    for n in range(N)
+                    if alive[n]
+                )
+                plane = [st for row in status for st in row]
+            tel["views_up"] = sum(1 for st in plane if st == ALIVE)
+            tel["views_suspect"] = sum(1 for st in plane if st == SUSPECT)
+            tel["views_down"] = sum(1 for st in plane if st == DOWN)
+            tel["n_alive"] = sum(1 for n in range(N) if alive[n])
+            tel["n_restarted"] = sum(1 for n in range(N) if restarted[n])
+            tel["part_active"] = int(part_active)
+            tel_rounds.append(tel)
         if total == N * K:
             result.converged = True
             result.rounds = r + 1
             break
 
+    if record:
+        from .flight import FlightRecord
+
+        result.flight = FlightRecord(
+            n_nodes=N,
+            n_changes=K,
+            nseq_max=p.nseq_max,
+            seed=p.seed,
+            packed=p.packed,
+            max_rounds=max_rounds,
+            rounds=result.rounds,
+            converged=result.converged,
+            schedule_hash=(
+                chaos.schedule.schedule_hash() if chaos is not None else None
+            ),
+            series={
+                f: [t[f] for t in tel_rounds] for f in TELEMETRY_FIELDS
+            },
+        )
     result.cov = cov
     result.have = [
         {k for k in range(K) if cov[n][k] == full[k]} for n in range(N)

@@ -155,6 +155,9 @@ class TelemetryConfig:
     # endpoint (OTLP/HTTP JSON) and/or a JSONL file sink
     otlp_endpoint: Optional[str] = None
     otlp_file: Optional[str] = None
+    # per-request HTTP timeout (seconds) for collector posts; failures
+    # increment corro.otlp.export.errors (doc/telemetry.md)
+    otlp_timeout: float = 5.0
 
 
 @dataclass

@@ -32,10 +32,12 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "counter",
+    "counter_snapshot",
     "gauge",
     "histogram",
     "registry",
     "render_prometheus",
+    "snapshot_delta",
 ]
 
 # reference exporter's custom buckets are seconds-scale latencies
@@ -203,6 +205,25 @@ class MetricsRegistry:
                     out.append(f"{pname}_count{_fmt_labels(key)} {h.total}")
         return "\n".join(out) + "\n"
 
+    def counter_snapshot(
+        self, prefix: str = ""
+    ) -> Dict[Tuple[str, LabelKey], float]:
+        """Point-in-time copy of every counter value whose name starts
+        with ``prefix``.  The snapshot is taken under the registry lock,
+        so no series is missed mid-registration; individual values are
+        plain reads of float slots the Counter lock protects (a torn
+        read cannot occur for CPython floats, and a racing ``inc`` lands
+        in whichever snapshot observes it — exactly the semantics of
+        scraping Prometheus text).  Feed two snapshots to
+        :func:`snapshot_delta` to get per-interval series."""
+        with self._lock:
+            return {
+                (name, key): c.value
+                for name, series in self._counters.items()
+                if name.startswith(prefix)
+                for key, c in series.items()
+            }
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
@@ -216,8 +237,29 @@ def _num(v: float) -> str:
     return repr(float(v))
 
 
+def snapshot_delta(
+    before: Dict[Tuple[str, LabelKey], float],
+    after: Dict[Tuple[str, LabelKey], float],
+    name: Optional[str] = None,
+) -> Dict[str, float]:
+    """Per-name counter increments between two ``counter_snapshot()``
+    calls, summed across label sets (the chaos-parity harness compares
+    process totals, not per-actor series).  Series absent from
+    ``before`` count from zero; pass ``name`` to restrict to one
+    series (returns ``{name: 0.0}`` if it never appeared)."""
+    out: Dict[str, float] = {}
+    for (nm, key), val in after.items():
+        if name is not None and nm != name:
+            continue
+        out[nm] = out.get(nm, 0.0) + (val - before.get((nm, key), 0.0))
+    if name is not None:
+        return {name: out.get(name, 0.0)}
+    return out
+
+
 registry = MetricsRegistry()
 counter = registry.counter
+counter_snapshot = registry.counter_snapshot
 gauge = registry.gauge
 histogram = registry.histogram
 render_prometheus = registry.render_prometheus

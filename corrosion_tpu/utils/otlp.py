@@ -30,6 +30,7 @@ from . import tracing
 logger = logging.getLogger(__name__)
 
 EXPORT_INTERVAL = 5.0
+HTTP_TIMEOUT = 5.0  # default; configurable via telemetry.otlp_timeout
 MAX_BATCH = 512  # spans per OTLP payload
 MAX_QUEUE = 8192  # drop-newest beyond this: tracing must not OOM the node
 SERVICE_VERSION = "0.1.0"
@@ -100,11 +101,13 @@ class OtlpExporter:
         service_name: str = "corrosion-tpu",
         interval: float = EXPORT_INTERVAL,
         extra_attrs: Optional[dict] = None,
+        timeout: float = HTTP_TIMEOUT,
     ) -> None:
         self.endpoint = endpoint
         self.file_path = file_path
         self.service_name = service_name
         self.interval = interval
+        self.timeout = timeout
         self.extra_attrs = extra_attrs or {}
         self._queue: "asyncio.Queue[tracing.SpanRecord]" = asyncio.Queue(
             maxsize=MAX_QUEUE
@@ -166,6 +169,11 @@ class OtlpExporter:
             # keep the (possibly slow) filesystem off the event loop
             await asyncio.to_thread(_append)
         if self.endpoint:
+            # failures are logged AND counted: log lines get dropped by
+            # level filters, but a silently dead collector pipeline
+            # should show up on the metrics endpoint (doc/telemetry.md)
+            from .metrics import counter
+
             try:
                 from aiohttp import ClientSession
 
@@ -173,12 +181,14 @@ class OtlpExporter:
                     async with http.post(
                         self.endpoint.rstrip("/") + "/v1/traces",
                         json=payload,
-                        timeout=5,
+                        timeout=self.timeout,
                     ) as resp:
                         if resp.status >= 400:
+                            counter("corro.otlp.export.errors").inc()
                             logger.warning(
                                 "otlp export rejected: %s", resp.status
                             )
             except Exception:
+                counter("corro.otlp.export.errors").inc()
                 logger.debug("otlp http export failed", exc_info=True)
         return len(batch)

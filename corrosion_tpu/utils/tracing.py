@@ -20,6 +20,7 @@ import contextlib
 import contextvars
 import logging
 import secrets
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -82,16 +83,23 @@ class SpanRecord:
 _current: contextvars.ContextVar[Optional[TraceContext]] = (
     contextvars.ContextVar("corro_trace", default=None)
 )
+# the ring buffer and exporter list are PROCESS-GLOBAL and written from
+# any thread that closes a span (pool worker threads trace too, like the
+# metrics Counter lock) — every access goes through _lock: deque.append
+# alone is atomic, but list(_spans) iterates, and a concurrent append
+# during iteration raises RuntimeError
+_lock = threading.Lock()
 _spans: Deque[SpanRecord] = deque(maxlen=SPAN_BUFFER)
 _exporters: list = []  # objects with .enqueue(SpanRecord)
 
 
 def add_exporter(exporter) -> None:
-    _exporters.append(exporter)
+    with _lock:
+        _exporters.append(exporter)
 
 
 def remove_exporter(exporter) -> None:
-    with contextlib.suppress(ValueError):
+    with _lock, contextlib.suppress(ValueError):
         _exporters.remove(exporter)
 
 
@@ -102,7 +110,8 @@ def current_traceparent() -> Optional[str]:
 
 
 def recent_spans() -> list:
-    return list(_spans)
+    with _lock:
+        return list(_spans)
 
 
 @contextlib.contextmanager
@@ -137,8 +146,13 @@ def span(
             duration=duration,
             attributes={k: str(v) for k, v in attributes.items()},
         )
-        _spans.append(record)
-        for exporter in _exporters:
+        # snapshot the exporter list under the lock, then enqueue OUTSIDE
+        # it: exporters may block (file write), and holding _lock across
+        # a slow enqueue would stall every thread closing a span
+        with _lock:
+            _spans.append(record)
+            exporters = list(_exporters)
+        for exporter in exporters:
             with contextlib.suppress(Exception):
                 exporter.enqueue(record)
         logger.debug(

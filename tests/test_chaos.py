@@ -326,6 +326,72 @@ def test_chaos_compare_acceptance():
     assert "corro_chaos_schedule_hash" in text
 
 
+def test_chaos_compare_telemetry_parity():
+    """ISSUE 4 acceptance: per-round broadcast / sync / membership
+    series for BOTH legs under one 16-node partition+crash+drop
+    schedule, with bounded gap — cumulative message counts within ±2%
+    and the membership up-count series exactly equal.  The seed and the
+    suspicion window are pinned where the paired runs agree exactly
+    (doc/ops.md: shorter windows let runtime cross-cut suspects expire
+    to DOWN before a probe refutes them, a timing artifact the
+    consensus-view sim has no analogue for)."""
+    from corrosion_tpu.chaos.compare import compare, params_for
+
+    gp = GenParams(
+        n_nodes=16, n_rounds=48, seed=3,
+        partition_frac_ppm=300_000, partition_rounds=2,
+        crash_ppm=40_000, crash_rounds=3, crash_down_rounds=3,
+        drop_ppm=50_000, drop_rounds=8,
+    )
+    s = generate(gp)
+    assert {PARTITION, CRASH, LINK} <= {e.kind for e in s.events}
+    p = params_for(s).with_(swim_suspicion_rounds=7)
+    res = asyncio.run(compare(s, p))
+    assert res.harness_rounds is not None and res.sim_rounds is not None
+    assert res.gap is not None and res.gap <= 0.02
+    # both legs reported full per-round series
+    assert res.series_runtime is not None and res.series_sim is not None
+    rounds = min(res.harness_rounds, res.sim_rounds)
+    for key in ("bcast_sent", "bcast_resent", "sync_recv", "members_up"):
+        assert len(res.series_runtime[key]) >= rounds, key
+    for key in ("bcast_sends", "sync_chunks", "members_up"):
+        assert len(res.series_sim[key]) >= rounds, key
+    gaps = res.series_gap
+    assert gaps is not None
+    assert gaps["bcast"] <= 0.02, f"broadcast series gap {gaps}"
+    assert gaps["sync"] <= 0.02, f"sync series gap {gaps}"
+    assert res.members_up_equal is True, (
+        res.series_runtime["members_up"],
+        res.series_sim["members_up"],
+    )
+    d = res.to_dict()
+    assert d["series_gap"] == gaps and d["members_up_equal"] is True
+
+
+def test_chaos_flight_artifact_determinism():
+    """Two recorded sim runs of the SAME schedule produce byte-identical
+    flight artifacts (the schedule hash is part of the header); a
+    different-seed schedule diverges."""
+    from corrosion_tpu.chaos.compare import params_for
+    from corrosion_tpu.chaos.lower import lower
+    from corrosion_tpu.sim import flight
+
+    s = generate(ACCEPT_GP)
+    p = params_for(s)
+    low = lower(s, horizon=p.max_rounds)
+    a = run_reference(p, chaos=low, record=True).flight
+    b = run_reference(p, chaos=low, record=True).flight
+    assert a.schedule_hash == s.schedule_hash()
+    assert flight.to_ndjson(a) == flight.to_ndjson(b)
+    assert flight.record_hash(a) == flight.record_hash(b)
+
+    s2 = generate(GenParams(**{**ACCEPT_GP.__dict__, "seed": 9}))
+    p2 = params_for(s2)
+    c = run_reference(p2, chaos=lower(s2, horizon=p2.max_rounds),
+                      record=True).flight
+    assert flight.record_hash(c) != flight.record_hash(a)
+
+
 def test_compare_rejects_sim_only_and_never_reviving_schedules():
     from corrosion_tpu.chaos.compare import check_harness_runnable
 

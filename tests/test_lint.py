@@ -559,6 +559,69 @@ jax.jit(lambda w: lane_nonzero(w, 4))
     assert trace_rules(good) == set()
 
 
+# -- flight recorder: lint gate + scan stacked-output fixtures ----------------
+
+def test_cli_lint_flight_recorder_clean_at_warning():
+    """ISSUE 4 satellite: the flight recorder and its consumers hold the
+    warning bar — sim/flight.py, the parity leg in chaos/compare.py and
+    the `sim trace` CLI all lint clean at --fail-on warning, with no new
+    suppressions."""
+    proc = cli_lint([
+        "--fail-on=warning",
+        "corrosion_tpu/sim/flight.py",
+        "corrosion_tpu/chaos/compare.py",
+        "corrosion_tpu/cli",
+    ])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_gl101_python_branch_on_scan_done_flag():
+    # the bug the done-gated scan must avoid: `done` is reduced from the
+    # carry INSIDE the scan body, so it is a tracer — a Python `if` on it
+    # would fail at trace time (and silently freeze the telemetry if it
+    # did not)
+    bad = """
+import jax
+from jax import lax
+def make_step(full):
+    def body(state, _):
+        cov, r = state
+        done = (cov == full).all()
+        if done:
+            return (cov, r), 0
+        return (cov | 1, r + 1), 1
+    return lambda s0: lax.scan(body, s0, None, length=8)
+"""
+    assert "GL101" in trace_rules(bad)
+
+
+def test_flight_done_gated_scan_idiom_not_flagged():
+    # the shipped idiom (sim/cluster.py record=True path): lax.cond gates
+    # the step on the traced done flag — converged rounds pass the carry
+    # through unchanged with zero telemetry, keeping the scan
+    # bit-identical to the while_loop exit; the `telemetry: bool` flag is
+    # a static build-time parameter, branchable in Python
+    good = """
+import jax, jax.numpy as jnp
+from jax import lax
+def make_step(p, telemetry: bool = False):
+    def body(state, _):
+        cov, r = state
+        done = (cov == jnp.int32(3)).all()
+        def stalled(s):
+            return s, jnp.zeros((4,), jnp.int32)
+        def live(s):
+            c, rr = s
+            tel = jnp.zeros((4,), jnp.int32)
+            if telemetry:
+                tel = tel.at[0].set(c.sum())
+            return (c | 1, rr + 1), tel
+        return lax.cond(done, stalled, live, state)
+    return lambda s0: lax.scan(body, s0, None, length=8)
+"""
+    assert trace_rules(good) == set()
+
+
 # -- agent --self-check metric -----------------------------------------------
 
 def test_self_check_emits_lint_findings_total():

@@ -1,10 +1,13 @@
 """OTLP trace export (ref: the reference's OTLP pipeline at
 corrosion/src/main.rs:55-134) — spans flow to a collector endpoint
 (OTLP/HTTP JSON, stubbed locally) and to a JSONL file sink, including
-cross-node sync spans that share one trace id."""
+cross-node sync spans that share one trace id.  Also covers the
+process-global span buffer's thread safety and the configurable export
+timeout + ``corro.otlp.export.errors`` counter."""
 
 import asyncio
 import json
+import threading
 
 from aiohttp import web
 
@@ -104,6 +107,138 @@ def test_node_wires_exporter(tmp_path):
                 for a in rs["resource"]["attributes"]
             }
             assert attrs["corrosion.actor"] == node.agent.actor_id.as_simple()
+        finally:
+            await node.stop()
+
+    run(main())
+
+def test_concurrent_spans_thread_safe():
+    """The span ring buffer and exporter list are process-global and
+    written from any thread that closes a span (pool workers trace too);
+    readers snapshot concurrently.  Unlocked, ``list(_spans)`` raises
+    ``RuntimeError: deque mutated during iteration`` under this load."""
+
+    class _Exp:
+        def __init__(self):
+            self.seen = []  # list.append is atomic under the GIL
+
+        def enqueue(self, record):
+            self.seen.append(record)
+
+    exp = _Exp()
+    tracing.add_exporter(exp)
+    errors = []
+    stop = threading.Event()
+    n_writers, per_writer = 4, 300
+
+    def writer(i):
+        try:
+            for _ in range(per_writer):
+                with tracing.span(f"t.w{i}"):
+                    pass
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    def churner():
+        # exporters register/unregister while spans close
+        try:
+            for _ in range(per_writer):
+                e = _Exp()
+                tracing.add_exporter(e)
+                tracing.remove_exporter(e)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                tracing.recent_spans()
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    try:
+        rt = threading.Thread(target=reader)
+        rt.start()
+        threads = [
+            threading.Thread(target=writer, args=(i,))
+            for i in range(n_writers)
+        ] + [threading.Thread(target=churner)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        rt.join()
+    finally:
+        tracing.remove_exporter(exp)
+    assert not errors, errors
+    # every close reached the exporter registered for the whole test
+    assert len(exp.seen) >= n_writers * per_writer
+
+
+def test_export_error_counter_and_timeout(tmp_path):
+    from corrosion_tpu.utils.metrics import registry
+
+    async def main():
+        async def collector(request):
+            return web.json_response({}, status=500)
+
+        app = web.Application()
+        app.router.add_post("/v1/traces", collector)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        c = registry.counter("corro.otlp.export.errors")
+        before = c.value
+        exporter = OtlpExporter(
+            endpoint=f"http://127.0.0.1:{port}",
+            interval=60.0,
+            timeout=1.5,
+        ).start()
+        try:
+            assert exporter.timeout == 1.5
+            with tracing.span("rejected"):
+                pass
+            await exporter.flush()
+            assert c.value == before + 1  # HTTP 4xx/5xx counts
+        finally:
+            await exporter.stop()
+            await runner.cleanup()
+
+        # transport failure (nothing listening) counts too
+        dead = OtlpExporter(
+            endpoint="http://127.0.0.1:9", interval=60.0, timeout=0.5
+        ).start()
+        try:
+            with tracing.span("unreachable"):
+                pass
+            await dead.flush()
+            assert c.value == before + 2
+        finally:
+            await dead.stop()
+
+    run(main())
+
+
+def test_node_threads_otlp_timeout(tmp_path):
+    from corrosion_tpu.agent.node import Node
+    from corrosion_tpu.types.config import Config
+
+    # TOML section -> dataclass field mapping needs no parsing code
+    cfg = Config.from_dict({"telemetry": {"otlp_timeout": 1.25}})
+    assert cfg.telemetry.otlp_timeout == 1.25
+
+    async def main():
+        cfg = Config()
+        cfg.db.path = ":memory:"
+        cfg.telemetry.otlp_file = str(tmp_path / "t.jsonl")
+        cfg.telemetry.otlp_timeout = 2.5
+        node = await Node(cfg).start()
+        try:
+            assert node.otlp is not None and node.otlp.timeout == 2.5
         finally:
             await node.stop()
 

@@ -1,0 +1,216 @@
+"""Flight recorder (sim/flight.py) — per-round telemetry from the hot loop.
+
+Layers under test, cheapest first:
+
+1. non-perturbation — ``record=True`` switches the while_loop to a
+   bounded done-gated scan; round counts AND final state must be
+   bit-identical to ``record=False`` on all five BASELINE configs
+   (reduced scale), packed and unpacked, plus the per-node view variant;
+2. executor parity — the JAX scan's stacked series equals the scalar
+   reference's ``record=True`` series field-for-field, round-for-round
+   (the reference is the fidelity anchor, tests/test_sim.py);
+3. artifact determinism — same (params, seed) twice produces
+   byte-identical NDJSON (mirrors the tests/test_chaos.py digest
+   contract); a different seed produces a different artifact;
+4. consumers — convergence quantiles, ``corro.sim.round.*`` gauges, the
+   BENCHMARKS.md convergence section, and the ``sim trace`` CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim import cluster, flight, model
+from corrosion_tpu.sim.model import TELEMETRY_FIELDS
+from corrosion_tpu.sim.reference import run_reference
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _drop_compiled_programs():
+    # this module compiles ~16 scan/while programs; drop them on the way
+    # out so the timing-sensitive harness-fidelity tests that follow in a
+    # full run don't inherit the memory pressure
+    yield
+    import jax
+
+    jax.clear_caches()
+
+
+def small_configs():
+    # the BASELINE matrix at test scale (same shapes as tests/test_sim.py)
+    return {
+        "config1_ring3": model.config1_ring3(seed=7),
+        "config2_er": model.config2_er1k(seed=7).with_(
+            n_nodes=120, n_changes=16, max_rounds=128
+        ),
+        "config3_powerlaw": model.config3_powerlaw10k(seed=7).with_(
+            n_nodes=150, n_changes=16, write_rounds=4, max_rounds=256
+        ),
+        "config4_churn": model.config4_churn100k(seed=7).with_(
+            n_nodes=100, n_changes=16, write_rounds=4,
+            churn_rounds=6, max_rounds=256,
+        ),
+        "config5_partition": model.config5_partition100k(seed=7).with_(
+            n_nodes=100, n_changes=16, write_rounds=4,
+            partition_rounds=10, max_rounds=256,
+        ),
+        "config4_churn_pernode": model.config4_churn100k(seed=7).with_(
+            n_nodes=64, n_changes=16, write_rounds=4,
+            churn_rounds=6, max_rounds=256, swim_per_node_views=True,
+        ),
+    }
+
+
+def _states_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, b)
+    )
+
+
+# -- 1 + 2: non-perturbation and executor parity, one matrix ----------------
+
+
+@pytest.mark.parametrize("name", sorted(small_configs()))
+def test_recording_non_perturbing_and_matches_reference(name):
+    p = small_configs()[name]
+    ref = run_reference(p, record=True)
+    assert ref.flight is not None
+    for packed in (True, False):
+        pp = p.with_(packed=packed)
+        base = cluster.run(pp, return_state=True)
+        rec = cluster.run(pp, record=True, return_state=True)
+        # the ISSUE bar: bit-identical round counts and final state
+        assert rec.rounds == base.rounds, (name, packed)
+        assert rec.converged == base.converged, (name, packed)
+        assert _states_equal(rec.state, base.state), (name, packed)
+        # and the recorded series is the scalar reference's, exactly
+        assert rec.flight.rounds == ref.flight.rounds, (name, packed)
+        for f in TELEMETRY_FIELDS:
+            assert rec.flight.series[f] == ref.flight.series[f], (
+                name, packed, f,
+            )
+
+
+# -- 3: artifact determinism -------------------------------------------------
+
+
+def test_ndjson_byte_determinism_and_seed_divergence():
+    p = model.config2_er1k(seed=7).with_(
+        n_nodes=60, n_changes=8, max_rounds=128
+    )
+    a = flight.record_run(p).flight
+    b = flight.record_run(p).flight
+    assert flight.to_ndjson(a) == flight.to_ndjson(b)
+    assert flight.record_hash(a) == flight.record_hash(b)
+    c = flight.record_run(p.with_(seed=8)).flight
+    assert flight.to_ndjson(c) != flight.to_ndjson(a)
+    assert flight.record_hash(c) != flight.record_hash(a)
+
+
+def test_ndjson_roundtrip():
+    p = model.config1_ring3(seed=7)
+    rec = flight.record_run(p).flight
+    rt = flight.from_ndjson(flight.to_ndjson(rec))
+    assert rt == rec
+
+
+def test_packed_layout_is_part_of_artifact_identity():
+    # identical dynamics (series match bit-for-bit) but the header
+    # records the layout, so the artifacts hash differently
+    p = model.config1_ring3(seed=7)
+    a = flight.record_run(p.with_(packed=True)).flight
+    b = flight.record_run(p.with_(packed=False)).flight
+    assert a.series == b.series
+    assert flight.record_hash(a) != flight.record_hash(b)
+
+
+# -- 4: consumers ------------------------------------------------------------
+
+
+def _toy_record(nodes_complete, n_nodes=10, n_changes=4):
+    rounds = len(nodes_complete)
+    series = {f: [0] * rounds for f in TELEMETRY_FIELDS}
+    series["nodes_complete"] = list(nodes_complete)
+    series["complete_pairs"] = [v * n_changes for v in nodes_complete]
+    return flight.FlightRecord(
+        n_nodes=n_nodes, n_changes=n_changes, nseq_max=1, seed=0,
+        packed=True, max_rounds=rounds, rounds=rounds,
+        converged=nodes_complete[-1] == n_nodes, series=series,
+    )
+
+
+def test_rounds_to_fraction_quantiles():
+    rec = _toy_record([0, 2, 5, 9, 10])
+    assert flight.rounds_to_fraction(rec, 0.50) == 3  # ceil(5) at round 3
+    assert flight.rounds_to_fraction(rec, 0.90) == 4
+    assert flight.rounds_to_fraction(rec, 0.99) == 5
+    stuck = _toy_record([0, 1, 2])
+    assert flight.rounds_to_fraction(stuck, 0.99) is None
+    s = flight.summarize(rec)
+    assert (s["r50"], s["r90"], s["r99"]) == (3, 4, 5)
+    assert s["flight_sha256"] == flight.record_hash(rec)
+
+
+def test_publish_metrics_gauges():
+    from corrosion_tpu.utils.metrics import registry
+
+    p = model.config1_ring3(seed=7)
+    rec = flight.record_run(p).flight
+    flight.publish_metrics(rec)
+    text = registry.render_prometheus()
+    assert 'corro_sim_round_bcast_sends{nodes="3"}' in text
+    assert 'corro_sim_round_r50{nodes="3"}' in text
+    g = registry.gauge("corro.sim.round.bcast.sends", nodes="3")
+    assert g.value == sum(rec.series["bcast_sends"])
+
+
+def test_sparkline_and_convergence_section(tmp_path):
+    assert flight.sparkline([]) == ""
+    line = flight.sparkline([0.0, 0.5, 1.0])
+    assert len(line) == 3 and line[0] == " " and line[-1] == "█"
+    assert len(flight.sparkline([i / 99 for i in range(100)], width=40)) == 40
+
+    bench = tmp_path / "bench.json"
+    rows = [
+        {"metric": "sim_100n_config4_convergence_wall", "rounds": 12,
+         "r50": 5, "r90": 9, "r99": 11, "curve": [0.1, 0.6, 1.0],
+         "flight_sha256": "ab" * 32},
+        {"metric": "no_flight_fields"},  # skipped
+    ]
+    bench.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    md = tmp_path / "BENCHMARKS.md"
+    md.write_text("# Benchmarks\n\nprose stays\n")
+    flight.update_benchmarks(str(bench), str(md))
+    doc = md.read_text()
+    assert flight.BEGIN_MARK in doc and flight.END_MARK in doc
+    assert "prose stays" in doc
+    assert "| 100n_config4 | 12 | 5 | 9 | 11 |" in doc
+    assert ("ab" * 32)[:16] in doc
+    # idempotent: a second update replaces, never duplicates
+    flight.update_benchmarks(str(bench), str(md))
+    assert md.read_text().count(flight.BEGIN_MARK) == 1
+
+
+def test_cli_sim_trace_roundtrip(tmp_path):
+    out = tmp_path / "f.ndjson"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "corrosion_tpu.cli", "sim", "trace",
+         "--baseline", "1", "--seed", "7", "-o", str(out)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    ran = json.loads(proc.stdout)
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "corrosion_tpu.cli", "sim", "trace",
+         "--load", str(out)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300,
+    )
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert json.loads(proc2.stdout) == ran

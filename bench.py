@@ -44,11 +44,17 @@ def _cache_entries(cache_dir: str) -> int:
 
 
 def run_config(
-    n: int, seed: int, scale: float, dev, cache_dir: str, packed: bool = True
+    n: int,
+    seed: int,
+    scale: float,
+    dev,
+    cache_dir: str,
+    packed: bool = True,
+    framed: bool = True,
 ) -> dict:
     from corrosion_tpu.sim import cluster, crdt, flight, model, profile, reference
 
-    p = model.CONFIGS[n](seed=seed).with_(packed=packed)
+    p = model.CONFIGS[n](seed=seed).with_(packed=packed, framed=framed)
     if scale != 1.0:
         p = p.with_(n_nodes=max(8, int(p.n_nodes * scale)))
     log(f"config {n}: {p}")
@@ -168,7 +174,19 @@ def run_config(
     out["r90"] = fsum["r90"]
     out["r99"] = fsum["r99"]
     out["flight_sha256"] = fsum["flight_sha256"]
-    out["curve"] = [round(c, 4) for c in fres.flight.coverage()]
+    # run-length-compressed so a stalled run's flat tail doesn't bloat
+    # the JSON line (flight.expand_curve restores the per-round list)
+    out["curve"] = flight.compress_curve(
+        [round(c, 4) for c in fres.flight.coverage()]
+    )
+    # non-converged runs: stamp the round coverage stopped changing, so
+    # "converged": false distinguishes "still spreading at max_rounds"
+    # from "reachable coverage exhausted" (config 2's budget-bounded
+    # broadcast with sync_interval=0 can strand a node once every
+    # retransmission budget hits zero)
+    stall = flight.stalled_at(fres.flight)
+    if stall is not None:
+        out["stalled_at"] = stall
     return out
 
 
@@ -188,6 +206,12 @@ def main() -> None:
         action="store_true",
         help="run with the legacy uint8/int8 state planes (default: packed "
         "uint32 words, sim/pack.py)",
+    )
+    ap.add_argument(
+        "--dense",
+        action="store_true",
+        help="apply broadcast/sync through dense [N,K] delivery planes "
+        "(default: bounded message frames + segment-combine, sim/frames.py)",
     )
     args = ap.parse_args()
 
@@ -210,6 +234,7 @@ def main() -> None:
     log(f"device: {dev.platform} ({dev.device_kind})")
 
     packed = not args.unpacked
+    framed = not args.dense
 
     # the full BASELINE config set; headline config 4 goes LAST so
     # last-line JSON parsers record it
@@ -222,7 +247,9 @@ def main() -> None:
         if n == 4 and args.config is None and args.scale == 1.0:
             from corrosion_tpu.sim import model, profile
 
-            p1m = model.CONFIGS[4](seed=args.seed).with_(packed=packed)
+            p1m = model.CONFIGS[4](seed=args.seed).with_(
+                packed=packed, framed=framed
+            )
             p1m = p1m.with_(n_nodes=p1m.n_nodes * 10)
             need = profile.peak_round_bytes_estimate(p1m)
             try:
@@ -231,7 +258,8 @@ def main() -> None:
                 limit = 0
             if dev.platform != "cpu" and limit >= 1.5 * need:
                 out = run_config(
-                    4, args.seed, 10.0, dev, cache_dir, packed=packed
+                    4, args.seed, 10.0, dev, cache_dir,
+                    packed=packed, framed=framed,
                 )
                 print(json.dumps(out), flush=True)
             else:
@@ -240,7 +268,10 @@ def main() -> None:
                     f"device memory (have "
                     f"{limit / 1e9:.1f} GB on {dev.platform})"
                 )
-        out = run_config(n, args.seed, args.scale, dev, cache_dir, packed=packed)
+        out = run_config(
+            n, args.seed, args.scale, dev, cache_dir,
+            packed=packed, framed=framed,
+        )
         print(json.dumps(out), flush=True)
     log(f"total harness wall (incl. imports): {time.perf_counter()-t_all:.2f}s")
 

@@ -36,11 +36,19 @@ per word), and the round transition keeps the word algebra end to end:
 inject is a disjoint-lane scatter-add, receive/churn are carry-free
 shift/mask arithmetic, the anti-entropy needs rule runs on words
 (sync.jx_available_packed) and convergence is a packed-word compare with
-popcount completions.  Only the broadcast scatter planes stay per-chunk
-boolean [N, K] (a scatter-max over multi-bit words is NOT a bitwise OR —
-lanes from different payloads would drop bits), and those are transient,
-not live state.  3-5× less HBM per round; trajectories bit-identical
-(tests/test_sim_pack.py).  sim/profile.py measures the bytes.
+popcount completions.  On the dense path the broadcast scatter planes
+stay per-chunk boolean [N, K] (a scatter-max over multi-bit words is NOT
+a bitwise OR — lanes from different payloads would drop bits), transient
+but dominant in bytes/round; with ``p.framed`` those planes are replaced
+by bounded sparse message frames (sim/frames.py) — flat
+(target, kword, word) arrays of length O(N·fanout·S) applied by
+sort + segmented OR straight into the packed words, behind a
+``lax.cond`` plateau gate that skips the whole fanout on rounds with no
+held-and-budgeted chunks anywhere (safe: the counter RNG keys on
+(seed, tag, round), so skipped draws never shift later rounds).  3-5×
+less HBM per round packed, and frames cut the per-round traffic again
+(sim/profile.py measures the bytes); trajectories bit-identical
+(tests/test_sim_pack.py, tests/test_sim_frames.py).
 
 Fidelity contract with the scalar mirror is enforced by tests/test_sim.py
 (exact round-count and state equality on all five BASELINE configs, small
@@ -73,6 +81,7 @@ from .rng import (
     TAG_TOPO,
     jx_below,
 )
+from . import frames as framesmod
 from . import pack
 from . import sync as syncmod
 
@@ -222,6 +231,16 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
         ks_k = ks // S
         T32 = jnp.uint32(p.max_transmissions)
         valid_w = jnp.asarray(pack.valid_lane_mask(p))
+    if p.framed:
+        # framed-layout constants: the broadcast frame lives in cov WORD
+        # space whatever the state layout (sim/frames.py), so the
+        # lane/word maps are needed even when p.packed is False
+        f_cb = pack.lane_bits(p)
+        f_wc = pack.cov_words(p)
+        f_kword = karange // pack.lanes_per_word(p)
+        f_kshift = (karange % pack.lanes_per_word(p)).astype(
+            jnp.uint32
+        ) * jnp.uint32(f_cb)
 
     def death(x):
         """bool[N]: churn death draw hit at round x (x may be negative)."""
@@ -566,77 +585,221 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
         # needed); targets are [N, K] so the scatter is elementwise
         # (t[n, k], k) ← pay[n, k]
         if p.packed:
-            # pend/hold bits come straight off the word planes via lane
-            # shift algebra; only the scatter planes and their uint8
-            # accumulator are per-changeset, and they are transients
-            # fused into the scatter — not live state
+            # pend bits come straight off the word planes via lane shift
+            # algebra — shared by the framed frame build and the dense
+            # scatter planes, and by the receive-phase budget decrement
             pend_lsb = pack.lane_nonzero(budget, bb)  # [N, Wb] LSB flags
-            pend = jnp.logical_and(
-                pack.unpack_budget(pend_lsb, p) != 0, alive[:, None, None]
-            )
-            covu = pack.unpack_cov(cov, p)  # transient lane values
-        else:
-            pend = jnp.logical_and(budget > 0, alive[:, None, None])
-            covu = cov
-        delivered = jnp.zeros((N, K), dtype=jnp.uint8)
-        kk = jnp.broadcast_to(kvec, (N, K))
         if telemetry:
             # sends = payloads dispatched to a FOUND (believed-up) target,
             # before delivery gating — what the runtime's
             # corro.broadcast.sent/resent count at the send call site
             tel_bcast = jnp.int32(0)
-        for s in range(S):
-            bit = jnp.uint8(1 << s)
-            plane = jnp.zeros((N, K), dtype=bool)
-            hold = jnp.logical_and(pend[:, :, s], (covu & bit).astype(bool))
-            if p.fanout_per_change:
-                chosen = []
-                for j in range(p.fanout):
-                    slot = j * S + s
-                    t, found = draw_excluding(
-                        down2,
-                        view[:, None],
-                        lambda a, slot=slot, ch=tuple(chosen): bcast_target(
-                            r, slot, a, ch
-                        ),
-                    )
-                    ok = jnp.logical_and(
-                        jnp.logical_and(found, pvec[:, None] == pvec[t]),
-                        alive[t],
-                    )
-                    if c_drop is not None:
-                        ok = jnp.logical_and(ok, link_up(nvec, t))
-                    if telemetry:
-                        tel_bcast = tel_bcast + jnp.logical_and(
-                            hold, found
-                        ).sum(dtype=jnp.int32)
-                    plane = plane.at[t, kk].max(hold & ok)
-                    chosen.append(t)
+        if p.framed:
+            # -- framed fanout (sim/frames.py): the hold plane stays in
+            # cov WORD space — chunk bit (k, s) set iff node n holds the
+            # chunk AND its budget lane is nonzero — and each (chunk,
+            # fanout) slot contributes flat frame rows instead of a dense
+            # [N, K] scatter plane
+            if p.packed:
+                pend_w = jnp.where(alive[:, None], pend_lsb, jnp.uint32(0))
+                hold_w = cov & pack.chunk_flags_to_cov_words(pend_w, p)
             else:
-                for j in range(p.fanout):
-                    slot = j * S + s
-                    t, found = draw_excluding(
-                        down2,
-                        view,
-                        lambda a, slot=slot: bcast_target_shared(r, slot, a),
-                    )
-                    ok = jnp.logical_and(
-                        jnp.logical_and(found, pvec == pvec[t]), alive[t]
-                    )
-                    if c_drop is not None:
-                        ok = jnp.logical_and(ok, link_up(narange, t))
-                    if telemetry:
-                        tel_bcast = tel_bcast + jnp.logical_and(
-                            hold, found[:, None]
-                        ).sum(dtype=jnp.int32)
-                    plane = plane.at[t].max(hold & ok[:, None])
-            delivered = delivered | jnp.where(plane, bit, jnp.uint8(0))
+                pend = jnp.logical_and(budget > 0, alive[:, None, None])
+                hold_w = pack.pack_cov(cov, p) & pack.chunk_flags_to_cov_words(
+                    pack.pack_chunk_flags(pend, p), p
+                )
+
+            def bcast_framed(_):
+                """Draws + frame build + segmented-OR apply.  Runs under
+                the plateau-gate ``lax.cond``, so rounds with no
+                held-and-budgeted chunk anywhere (the flat stretches of
+                the config-5 curve) skip the draws, the sort and the
+                scatter entirely.  Safe to skip: the counter RNG keys on
+                (seed, tag, round) — skipped draws never shift later
+                rounds — and hold ≡ 0 forces delivered ≡ 0 and zero send
+                telemetry on the dense path too, so trajectories and
+                flight series are unchanged (tests/test_sim_frames.py)."""
+                tel = jnp.int32(0)
+                keys_l, vals_l = [], []
+                for s in range(S):
+                    # bit s of every lane: this slot's held chunks
+                    mask_s = jnp.uint32(pack.lane_lsb_mask(f_cb) << s)
+                    hold_s = hold_w & mask_s  # [N, Wc]
+                    if p.fanout_per_change:
+                        # entry frame: per-payload targets [N, K]; the
+                        # value is the payload's single chunk bit in word
+                        # space, the key its flat (target, kword) cell
+                        hk = hold_s[:, f_kword]  # [N, K] word per payload
+                        bitm = jnp.uint32(1) << (f_kshift + jnp.uint32(s))
+                        val_nk = hk & bitm[None, :]
+                        chosen = []
+                        for j in range(p.fanout):
+                            slot = j * S + s
+                            t, found = draw_excluding(
+                                down2,
+                                view[:, None],
+                                lambda a, slot=slot, ch=tuple(
+                                    chosen
+                                ): bcast_target(r, slot, a, ch),
+                            )
+                            ok = jnp.logical_and(
+                                jnp.logical_and(
+                                    found, pvec[:, None] == pvec[t]
+                                ),
+                                alive[t],
+                            )
+                            if c_drop is not None:
+                                # lowered drop planes filter the FRAME:
+                                # the row value is zeroed before it
+                                # enters the segment combine (same
+                                # per-link draw as the dense path)
+                                ok = jnp.logical_and(ok, link_up(nvec, t))
+                            if telemetry:
+                                tel = tel + jnp.logical_and(
+                                    val_nk != 0, found
+                                ).sum(dtype=jnp.int32)
+                            keys_l.append(
+                                (
+                                    t.astype(jnp.int32) * f_wc
+                                    + f_kword[None, :]
+                                ).reshape(-1)
+                            )
+                            vals_l.append(
+                                jnp.where(
+                                    ok, val_nk, jnp.uint32(0)
+                                ).reshape(-1)
+                            )
+                            chosen.append(t)
+                    else:
+                        for j in range(p.fanout):
+                            slot = j * S + s
+                            t, found = draw_excluding(
+                                down2,
+                                view,
+                                lambda a, slot=slot: bcast_target_shared(
+                                    r, slot, a
+                                ),
+                            )
+                            ok = jnp.logical_and(
+                                jnp.logical_and(found, pvec == pvec[t]),
+                                alive[t],
+                            )
+                            if c_drop is not None:
+                                ok = jnp.logical_and(
+                                    ok, link_up(narange, t)
+                                )
+                            if telemetry:
+                                tel = tel + pack.popcount32(
+                                    jnp.where(
+                                        found[:, None],
+                                        hold_s,
+                                        jnp.uint32(0),
+                                    )
+                                ).sum()
+                            # row frame: the sender's whole chunk-s word
+                            # row rides to one target — every payload on
+                            # the link in a single segment-OR row
+                            keys_l.append(t.astype(jnp.int32))
+                            vals_l.append(
+                                jnp.where(
+                                    ok[:, None], hold_s, jnp.uint32(0)
+                                )
+                            )
+                keys = jnp.concatenate(keys_l)
+                vals = jnp.concatenate(vals_l, axis=0)
+                if p.fanout_per_change:
+                    dw = framesmod.apply_entry_frame(keys, vals, N, f_wc)
+                else:
+                    dw = framesmod.apply_row_frame(keys, vals, N)
+                return dw, tel
+
+            traffic = jnp.any(hold_w != jnp.uint32(0))
+            delivered_w, tel_b = lax.cond(
+                traffic,
+                bcast_framed,
+                lambda _: (
+                    jnp.zeros((N, f_wc), dtype=jnp.uint32),
+                    jnp.int32(0),
+                ),
+                0,
+            )
+            if telemetry:
+                tel_bcast = tel_b
+            if not p.packed:
+                delivered = pack.unpack_cov(delivered_w, p)
+        else:
+            if p.packed:
+                # dense path: unpack transients feed the per-changeset
+                # scatter planes; only those planes and their uint8
+                # accumulator are per-changeset, and they are transients
+                # fused into the scatter — not live state
+                pend = jnp.logical_and(
+                    pack.unpack_budget(pend_lsb, p) != 0,
+                    alive[:, None, None],
+                )
+                covu = pack.unpack_cov(cov, p)  # transient lane values
+            else:
+                pend = jnp.logical_and(budget > 0, alive[:, None, None])
+                covu = cov
+            delivered = jnp.zeros((N, K), dtype=jnp.uint8)
+            kk = jnp.broadcast_to(kvec, (N, K))
+            for s in range(S):
+                bit = jnp.uint8(1 << s)
+                plane = jnp.zeros((N, K), dtype=bool)
+                hold = jnp.logical_and(
+                    pend[:, :, s], (covu & bit).astype(bool)
+                )
+                if p.fanout_per_change:
+                    chosen = []
+                    for j in range(p.fanout):
+                        slot = j * S + s
+                        t, found = draw_excluding(
+                            down2,
+                            view[:, None],
+                            lambda a, slot=slot, ch=tuple(
+                                chosen
+                            ): bcast_target(r, slot, a, ch),
+                        )
+                        ok = jnp.logical_and(
+                            jnp.logical_and(found, pvec[:, None] == pvec[t]),
+                            alive[t],
+                        )
+                        if c_drop is not None:
+                            ok = jnp.logical_and(ok, link_up(nvec, t))
+                        if telemetry:
+                            tel_bcast = tel_bcast + jnp.logical_and(
+                                hold, found
+                            ).sum(dtype=jnp.int32)
+                        plane = plane.at[t, kk].max(hold & ok)
+                        chosen.append(t)
+                else:
+                    for j in range(p.fanout):
+                        slot = j * S + s
+                        t, found = draw_excluding(
+                            down2,
+                            view,
+                            lambda a, slot=slot: bcast_target_shared(
+                                r, slot, a
+                            ),
+                        )
+                        ok = jnp.logical_and(
+                            jnp.logical_and(found, pvec == pvec[t]), alive[t]
+                        )
+                        if c_drop is not None:
+                            ok = jnp.logical_and(ok, link_up(narange, t))
+                        if telemetry:
+                            tel_bcast = tel_bcast + jnp.logical_and(
+                                hold, found[:, None]
+                            ).sum(dtype=jnp.int32)
+                        plane = plane.at[t].max(hold & ok[:, None])
+                delivered = delivered | jnp.where(plane, bit, jnp.uint8(0))
 
         # 4. receive: accumulate chunks; a newly received chunk refreshes
         # ITS OWN budget only (one pending payload per chunk, like the
         # runtime); every pending chunk that sent this round decrements
         if p.packed:
-            delivered_w = pack.pack_cov(delivered, p)
+            if not p.framed:
+                delivered_w = pack.pack_cov(delivered, p)
             new_w = delivered_w & ~cov
             new_w = jnp.where(alive[:, None], new_w, jnp.uint32(0))
             cov = cov | new_w
@@ -697,13 +860,11 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                 consumes no state, so skipping draws is trajectory-free.
                 """
                 if p.packed:
-                    # heads need per-changeset "any coverage" flags only:
-                    # lane-fold to LSBs, unpack 0/1 (transient)
-                    seen = pack.unpack_cov(pack.lane_nonzero(c, cb), p)
-                    heads_mine = syncmod.jx_heads(seen, aidx, vidx, n_actors)
-                    avail = syncmod.jx_available_packed(
-                        c, c[q], full_w, heads_mine, aidx, vidx, p
-                    )
+                    # the needs rule stays in word space end to end: the
+                    # above-head case is a pointer-jumped suffix-OR over
+                    # uint8 seen flags inside jx_available_packed — no
+                    # per-(node, actor) heads tensor, no [N, K] int32
+                    avail = syncmod.jx_available_packed(c, c[q], full_w, p)
                     if p.sync_chunk_budget > 0:
                         # the (version, seq)-ordered cumsum cap wants
                         # per-changeset masks; transient unpack/repack
@@ -724,7 +885,10 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                     pulled = syncmod.jx_budget_transfer(
                         avail, p.sync_chunk_budget
                     )
-                return jnp.where(okq[:, None], c | pulled, c)
+                # sync sessions are identity-keyed frames (node n pulls
+                # into row n), so the frame apply degenerates to the
+                # sort-free masked OR — sim/frames.py owns the algebra
+                return framesmod.identity_frame_apply(c, okq, pulled)
 
             due = (r + 1) % p.sync_interval == 0
             if telemetry:
@@ -887,7 +1051,14 @@ def state_shardings(
     uint32[N, Wb] shard (node_axis, change_axis) — a word is 32/lane_bits
     whole changesets, so a word-axis split is a changeset-axis split and
     GSPMD still shards the round kernel on ('nodes' × 'changes'); pick
-    shapes where Wc/Wb divide the change_axis mesh extent."""
+    shapes where Wc/Wb divide the change_axis mesh extent.
+
+    Framed runs (``p.framed``) need no extra entries: the message frames
+    (sim/frames.py) are step-INTERNAL tensors keyed by target node, so
+    GSPMD routes them across ``node_axis`` as the sort/scatter's
+    collective — the frame IS what moves between shards, replacing the
+    dense-plane resharding of the scatter path
+    (``__graft_entry__.dryrun_multichip`` exercises framed × packed)."""
     out = []
     for x in jax.eval_shape(lambda: init_state(p)):
         ndim = getattr(x, "ndim", 0)

@@ -245,6 +245,67 @@ def save_npz(rec: FlightRecord, path: str) -> None:
 # -- convergence summaries ---------------------------------------------------
 
 
+def compress_curve(vals: List[float], min_run: int = 4) -> List[object]:
+    """Run-length-compress a coverage curve for the bench JSON artifact.
+
+    Stalled runs (config 2's budget-exhausted broadcast at reduced
+    scale) flatline for hundreds of rounds; storing one float per round
+    bloats every JSON line with a redundant tail.  Runs of ``min_run`` or
+    more identical values become a two-element ``[value, count]`` list;
+    shorter runs stay as scalars, so short curves round-trip unchanged.
+    """
+    out: List[object] = []
+    i = 0
+    while i < len(vals):
+        j = i
+        while j < len(vals) and vals[j] == vals[i]:
+            j += 1
+        n = j - i
+        if n >= min_run:
+            out.append([vals[i], n])
+        else:
+            out.extend(vals[i:j])
+        i = j
+    return out
+
+
+def expand_curve(comp: List[object]) -> List[float]:
+    """Inverse of :func:`compress_curve` (scalars pass through, so plain
+    uncompressed curves from older BENCH files expand to themselves)."""
+    out: List[float] = []
+    for v in comp:
+        if isinstance(v, (list, tuple)):
+            out.extend([float(v[0])] * int(v[1]))
+        else:
+            out.append(float(v))
+    return out
+
+
+def stalled_at(rec: FlightRecord) -> Optional[int]:
+    """For a non-converged record: the last 1-based round on which
+    ``complete_pairs`` still changed — every later round delivered
+    nothing new.  None when the run converged (or recorded no rounds).
+
+    This is the honest label for runs like BASELINE config 2 at reduced
+    scale: that config is pure bounded broadcast (``sync_interval=0``,
+    ``max_transmissions=6``) over a sparse ER graph, so once every
+    copy's retransmission budget hits zero an unlucky node that was
+    never drawn for some changeset can no longer be reached — at 100
+    nodes, seed 0, one node is left 10 changesets short and coverage
+    flatlines at 0.9984 for the remaining ~240 rounds.  ``converged:
+    false`` alone can't distinguish "still spreading at the horizon"
+    from "reachable coverage exhausted"; ``stalled_at`` can."""
+    if rec.converged:
+        return None
+    cp = rec.series.get("complete_pairs") or []
+    if not cp:
+        return None
+    for i in range(len(cp) - 1, 0, -1):
+        if cp[i] != cp[i - 1]:
+            return i + 1
+    return 1
+
+
 def rounds_to_fraction(rec: FlightRecord, frac: float) -> Optional[int]:
     """First round (1-based) where ≥ ``frac`` of nodes hold every
     changeset complete; None if the record never gets there."""
@@ -343,7 +404,11 @@ def convergence_markdown(lines: List[dict]) -> str:
         "left = round 1), the rounds at which 50/90/99% of nodes held",
         "every changeset, and the sha256 of the canonical NDJSON",
         "artifact — perf PRs diff these trajectories, not just ms/round.",
-        "`—` quantiles mean the run hit max_rounds first.",
+        "`—` quantiles mean the run hit max_rounds first; `stalled@r`",
+        "marks runs whose coverage stopped changing at round r (e.g.",
+        "config 2's budget-bounded broadcast with no sync exhausted",
+        "every retransmission budget with a node still short, so the",
+        "remaining coverage was unreachable).",
         "",
         "| metric | rounds | r50 | r90 | r99 | curve | flight sha256 |",
         "|---|---|---|---|---|---|---|",
@@ -356,14 +421,17 @@ def convergence_markdown(lines: List[dict]) -> str:
             v = ln.get(name)
             return "—" if v is None else str(v)
 
-        curve = ln.get("curve") or []
+        curve = expand_curve(ln.get("curve") or [])
         sha = ln.get("flight_sha256") or "?"
+        rcell = str(ln.get("rounds", "—"))
+        if ln.get("stalled_at") is not None:
+            rcell += " (stalled@{})".format(ln["stalled_at"])
         out.append(
             "| {m} | {r} | {r50} | {r90} | {r99} | `{c}` | `{h}` |".format(
                 m=str(ln.get("metric", "?"))
                 .replace("sim_", "")
                 .replace("_convergence_wall", ""),
-                r=ln.get("rounds", "—"),
+                r=rcell,
                 r50=q("r50"),
                 r90=q("r90"),
                 r99=q("r99"),

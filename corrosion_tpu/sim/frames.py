@@ -1,0 +1,174 @@
+"""Sparse message frames: bounded (target, kword, word) fanout tensors.
+
+After sim/pack.py the live state rides the round as uint32 words, but the
+dense broadcast path still materializes per-chunk boolean ``[N, K]``
+scatter planes — ~155 MB/round at 10k nodes (BENCH_r07.json) even though
+a round's ACTUAL traffic is only ``O(N · fanout)`` messages.  This module
+applies the plasma-PIC/MD pattern from PAPERS.md (sorted segment
+reductions over a bounded interaction list instead of dense all-pairs
+planes) to epidemic broadcast: each round emits a **message frame** —
+flat arrays over the outbound payloads — and applies it with
+sort-by-key + segmented OR directly into the packed ``cov`` word plane.
+
+Frame shapes are STATIC (the epidemic-broadcast fanout bound, the
+PlumTree/HyParView line in PAPERS.md): every slot of every node emits
+exactly one row whether or not it holds traffic, so XLA sees fixed
+shapes and GSPMD can route the frame across the 'nodes' mesh axis (the
+sort/scatter become the collective, replacing dense-plane resharding):
+
+- **row frames** (shared-draw fanout, ``fanout_per_change=False``): one
+  ``Wc``-word row per (chunk slot, fanout slot, node) — ``M = S·F·N``
+  rows keyed by target node.  The row is the sender's held-and-budgeted
+  chunk-s bits across ALL changesets, so one segmented OR lands every
+  payload on the link at once.
+- **entry frames** (per-payload draws, ``fanout_per_change=True``): one
+  uint32 word per (chunk slot, fanout slot, node, changeset) —
+  ``M = S·F·N·K`` entries keyed by flat ``target·Wc + kword``.
+
+The combine is bitwise OR, which scatter-max cannot express over
+multi-bit words (lanes from different payloads would drop bits — the
+sim/cluster.py:39 concession this module removes).  Instead:
+
+1. ``argsort`` the keys (duplicate targets become adjacent),
+2. segmented inclusive OR-scan via ``lax.associative_scan`` with the
+   standard (flag, value) segment operator,
+3. scatter-MAX the scanned values at the sorted keys: within a segment
+   the prefix-ORs only ever gain bits, so they are numerically
+   monotone and the max IS the segment's full OR.
+
+OR is commutative/associative, so sort stability is irrelevant and the
+result is bit-identical to the dense scatter planes; ``pack_cov``
+distributes over OR across disjoint lanes, so the framed ``delivered``
+words equal ``pack_cov`` of the dense plane exactly
+(tests/test_sim_frames.py holds this on all five BASELINE configs).
+
+Chaos interacts at frame-BUILD time: lowered drop planes zero a row's
+value before it enters the frame (same per-link TAG_CHAOS_DROP draw as
+the dense path and the runtime injector), and duplicate injection is
+OR-absorbed by the segment combine — a dup row is a no-op by algebra,
+not by filtering.
+
+Sync sessions are identity-keyed frames (node n pulls into row n), so
+their segment combine degenerates: :func:`identity_frame_apply` is the
+sort-free special case.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import pack
+from .model import SimParams
+
+# -- static frame bounds (host-side, nothing traced) -------------------------
+
+
+def row_frame_rows(p: SimParams) -> int:
+    """Rows in one round's shared-draw frame: one per
+    (chunk slot, fanout slot, sender)."""
+    return max(1, p.nseq_max) * p.fanout * p.n_nodes
+
+
+def entry_frame_entries(p: SimParams) -> int:
+    """Entries in one round's per-payload frame: one per
+    (chunk slot, fanout slot, sender, changeset)."""
+    return row_frame_rows(p) * p.n_changes
+
+
+def sync_frame_rows(p: SimParams) -> int:
+    """Static bound on one round's sync frame: at most one session per
+    node, each pulling at most a ``Wc``-word row (the per-session chunk
+    budget caps the bits set in the row, not its static width)."""
+    return p.n_nodes if p.sync_interval > 0 else 0
+
+
+def frame_bytes_per_round(p: SimParams) -> int:
+    """Bytes one round's frames occupy (values + int32 keys), the number
+    sim/profile.py folds into the roofline accounting.  Static — derived
+    from shapes only, so 1M-node budgets are computable anywhere."""
+    wc = pack.cov_words(p)
+    if p.fanout_per_change:
+        m = entry_frame_entries(p)
+        bcast = m * 4 + m * 4  # uint32 value + int32 flat key per entry
+    else:
+        m = row_frame_rows(p)
+        bcast = m * wc * 4 + m * 4  # Wc-word row + int32 target key
+    sync = sync_frame_rows(p) * wc * 4
+    return bcast + sync
+
+
+def frame_budget(p: SimParams) -> Dict[str, int]:
+    """Frame bounds + bytes for docs and telemetry (doc/simulator.md's
+    byte-budget table is generated from these numbers)."""
+    return {
+        "rows": (
+            entry_frame_entries(p)
+            if p.fanout_per_change
+            else row_frame_rows(p)
+        ),
+        "sync_rows": sync_frame_rows(p),
+        "frame_bytes_per_round": frame_bytes_per_round(p),
+    }
+
+
+# -- segmented OR (the frame apply kernel) -----------------------------------
+
+
+def _seg_or(a, b):
+    """Segmented-scan combine on (boundary flag, uint32 value) pairs:
+    a value ORs leftward until it crosses a segment boundary.  The
+    standard operator — associative, so safe under the tree-shaped
+    ``lax.associative_scan`` evaluation order (OR itself is commutative
+    and associative, which also makes sort stability irrelevant)."""
+    fa, va = a
+    fb, vb = b
+    return jnp.logical_or(fa, fb), jnp.where(fb, vb, va | vb)
+
+
+def segment_or(keys: jnp.ndarray, vals: jnp.ndarray, n_out: int) -> jnp.ndarray:
+    """OR-combine frame values by key: ``out[k] = OR of vals[i] where
+    keys[i] == k`` (zero where no entry), for ``vals`` of shape [M] or
+    [M, W] uint32 and int32 ``keys`` in [0, n_out).
+
+    sort → segmented inclusive OR-scan → scatter-max of the scanned
+    prefixes (monotone within a segment, so the max is the segment OR —
+    see the module docstring).  Keys of empty rows still occupy a
+    segment; their zero values are OR-identity, so padding rows are free.
+    """
+    order = jnp.argsort(keys)
+    sk = jnp.take(keys, order)
+    sv = jnp.take(vals, order, axis=0)
+    start = jnp.ones(sk.shape, dtype=bool).at[1:].set(sk[1:] != sk[:-1])
+    flags = start.reshape(start.shape + (1,) * (sv.ndim - 1))
+    _, scanned = lax.associative_scan((_seg_or), (flags, sv))
+    out = jnp.zeros((n_out,) + sv.shape[1:], dtype=jnp.uint32)
+    return out.at[sk].max(scanned)
+
+
+def apply_row_frame(
+    targets: jnp.ndarray, rows: jnp.ndarray, n_nodes: int
+) -> jnp.ndarray:
+    """Apply a row frame: [M] int32 targets + [M, Wc] uint32 rows →
+    [n_nodes, Wc] delivered words (OR-combined per target)."""
+    return segment_or(targets, rows, n_nodes)
+
+
+def apply_entry_frame(
+    keys: jnp.ndarray, vals: jnp.ndarray, n_nodes: int, n_words: int
+) -> jnp.ndarray:
+    """Apply an entry frame: [M] int32 flat keys (``target·Wc + kword``)
+    + [M] uint32 single-word values → [n_nodes, Wc] delivered words."""
+    flat = segment_or(keys, vals, n_nodes * n_words)
+    return flat.reshape(n_nodes, n_words)
+
+
+def identity_frame_apply(
+    dst: jnp.ndarray, ok: jnp.ndarray, rows: jnp.ndarray
+) -> jnp.ndarray:
+    """Apply an identity-keyed frame (sync sessions: row n targets node
+    n): the segment combine degenerates to a masked OR — no sort, no
+    scan.  ``dst`` [N, W], ``ok`` bool[N], ``rows`` [N, W] same dtype."""
+    return jnp.where(ok[:, None], dst | rows, dst)

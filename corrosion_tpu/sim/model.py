@@ -180,6 +180,14 @@ class SimParams:
     # the unpacked path and the scalar oracle (tests/test_sim_pack.py);
     # requires max_transmissions ≤ 15 (≤4-bit budget lanes)
     packed: bool = False
+    # sparse message frames (sim/frames.py): replace the dense per-chunk
+    # [N, K] broadcast scatter planes with bounded flat frames
+    # (target, kword, word_contrib) applied by sort + segmented OR —
+    # O(N·fanout·S) frame rows instead of O(N·K) plane bytes per round.
+    # Asserted bit-identical in round counts AND state to the dense path
+    # on all five BASELINE configs (tests/test_sim_frames.py); dense
+    # planes and sim/reference.py remain the oracle.  bench.py default ON.
+    framed: bool = False
     seed: int = 0
 
     def with_(self, **kw) -> "SimParams":

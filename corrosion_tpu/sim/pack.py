@@ -162,17 +162,99 @@ def pack_chunk_flags(flags: jnp.ndarray, p: SimParams) -> jnp.ndarray:
     return _pack_lanes(flat, budget_lane_bits(p), budget_words(p))
 
 
+# SWAR stride-2 bit compaction / deposit pairs: _gather_even extracts the
+# bits at even positions into the low half-word (bit 2j → bit j),
+# _spread_even is its exact inverse (bit j → bit 2j).  Applying either m
+# times converts stride 2**m ↔ stride 1 — the whole budget↔cov layout
+# bridge when the cov lane width equals S, with no unpacked temporaries.
+
+
+def _gather_even(x: jnp.ndarray) -> jnp.ndarray:
+    x = x & jnp.uint32(0x55555555)
+    x = (x | (x >> jnp.uint32(1))) & jnp.uint32(0x33333333)
+    x = (x | (x >> jnp.uint32(2))) & jnp.uint32(0x0F0F0F0F)
+    x = (x | (x >> jnp.uint32(4))) & jnp.uint32(0x00FF00FF)
+    x = (x | (x >> jnp.uint32(8))) & jnp.uint32(0x0000FFFF)
+    return x
+
+
+def _spread_even(x: jnp.ndarray) -> jnp.ndarray:
+    x = x & jnp.uint32(0x0000FFFF)
+    x = (x | (x << jnp.uint32(8))) & jnp.uint32(0x00FF00FF)
+    x = (x | (x << jnp.uint32(4))) & jnp.uint32(0x0F0F0F0F)
+    x = (x | (x << jnp.uint32(2))) & jnp.uint32(0x33333333)
+    x = (x | (x << jnp.uint32(1))) & jnp.uint32(0x55555555)
+    return x
+
+
 def cov_words_to_chunk_flags(words: jnp.ndarray, p: SimParams) -> jnp.ndarray:
     """cov-layout words → budget-layout lane-LSB flags: flag (k, s) set
-    iff chunk bit s of changeset k is set.  Pure shift/reshape — the
-    bridge the packed receive phase uses to turn newly-landed chunk words
-    into per-counter budget refresh masks."""
+    iff chunk bit s of changeset k is set — the bridge the packed receive
+    phase uses to turn newly-landed chunk words into per-counter budget
+    refresh masks.
+
+    When the cov lane width equals S (nseq_max a power of two — every
+    BASELINE config), flag j = k·S + s IS bit j of the cov word stream,
+    so the bridge is pure word-space SWAR: split each cov word into
+    ``bb`` groups of 32/bb bits and deposit each group at stride ``bb``
+    (log-step spreads, no unpacked temporaries).  Other lane widths fall
+    back to the unpack/repack shift path."""
     s_dim = max(1, p.nseq_max)
-    u = _unpack_lanes(words, lane_bits(p), p.n_changes)  # (..., K) lane values
+    cb, bb = lane_bits(p), budget_lane_bits(p)
+    if cb == s_dim:
+        steps = {2: 1, 4: 2}[bb]
+        group = 32 // bb  # flag-bits per budget word
+        parts = []
+        for m in range(bb):
+            x = (words >> jnp.uint32(m * group)) & jnp.uint32(
+                (1 << group) - 1
+            )
+            for _ in range(steps):
+                x = _spread_even(x)
+            parts.append(x)
+        out = jnp.stack(parts, axis=-1)  # (..., Wc, bb)
+        out = out.reshape(out.shape[:-2] + (out.shape[-2] * bb,))
+        return out[..., : budget_words(p)]
+    u = _unpack_lanes(words, cb, p.n_changes)  # (..., K) lane values
     srange = jnp.arange(s_dim, dtype=jnp.uint32)
     b = (u[..., None] >> srange) & jnp.uint32(1)  # (..., K, S)
     flat = b.reshape(b.shape[:-2] + (p.n_changes * s_dim,))
-    return _pack_lanes(flat, budget_lane_bits(p), budget_words(p))
+    return _pack_lanes(flat, bb, budget_words(p))
+
+
+def chunk_flags_to_cov_words(flags_w: jnp.ndarray, p: SimParams) -> jnp.ndarray:
+    """Inverse bridge of :func:`cov_words_to_chunk_flags`: budget-layout
+    lane-LSB flags → cov-layout words, chunk bit s of changeset k set iff
+    flag (k, s) was set — lets the framed broadcast path (sim/frames.py)
+    lift per-counter pending flags back into chunk word space.
+
+    Same structure as the forward bridge: when the cov lane width equals
+    S the flags compact at stride ``bb`` into consecutive cov bits (SWAR
+    log-step gathers, ``bb`` budget words folding into one cov word);
+    otherwise the unpack/repack shift path."""
+    s_dim = max(1, p.nseq_max)
+    cb, bb = lane_bits(p), budget_lane_bits(p)
+    if cb == s_dim:
+        steps = {2: 1, 4: 2}[bb]
+        group = 32 // bb  # flag-bits per budget word
+        x = flags_w
+        for _ in range(steps):
+            x = _gather_even(x)
+        wc = cov_words(p)
+        pad = wc * bb - budget_words(p)
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros(x.shape[:-1] + (pad,), dtype=jnp.uint32)],
+                axis=-1,
+            )
+        x = x.reshape(x.shape[:-1] + (wc, bb))
+        shifts = jnp.arange(bb, dtype=jnp.uint32) * jnp.uint32(group)
+        return jnp.sum(x << shifts, axis=-1, dtype=jnp.uint32)
+    f = _unpack_lanes(flags_w, bb, p.n_changes * s_dim)
+    b = f.reshape(f.shape[:-1] + (p.n_changes, s_dim))  # (..., K, S) 0/1
+    srange = jnp.arange(s_dim, dtype=jnp.uint32)
+    lane = jnp.sum(b << srange, axis=-1, dtype=jnp.uint32)  # (..., K)
+    return _pack_lanes(lane, cb, cov_words(p))
 
 
 # -- host-side layout constants ---------------------------------------------

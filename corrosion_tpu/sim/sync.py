@@ -133,6 +133,31 @@ def py_lowest_bits(m: int, b: int) -> int:
 # -- heads ------------------------------------------------------------------
 
 
+def next_version_index(p: SimParams) -> Tuple[np.ndarray, int]:
+    """([K] int32, steps): per-changeset position of the SAME actor's
+    next version (self-loop at each actor's last version), plus the
+    pointer-jumping step count ``ceil(log2(max versions per actor))``.
+
+    Within one actor the version number ``vidx`` ascends with changeset
+    id (commit order), so "is any version >= vidx[k] seen" is a
+    suffix-OR along the actor's — static, possibly interleaved —
+    version positions; ``jx_available_packed`` walks it by doubling
+    this map instead of materializing per-(node, actor) heads."""
+    aidx, _, _ = actor_index(p)
+    K = p.n_changes
+    nxt = np.arange(K, dtype=np.int32)
+    last: Dict[int, int] = {}
+    runs: Dict[int, int] = {}
+    for k in range(K - 1, -1, -1):
+        a = int(aidx[k])
+        nxt[k] = last.get(a, k)
+        last[a] = k
+        runs[a] = runs.get(a, 0) + 1
+    m = max(runs.values()) if runs else 1
+    steps = int(np.ceil(np.log2(m))) if m > 1 else 0
+    return nxt, steps
+
+
 def jx_heads(cov: jnp.ndarray, aidx, vidx, n_actors: int) -> jnp.ndarray:
     """[N, A] int32: per (node, actor) head = highest version with any
     coverage (buffered partials count as seen, matching BookedVersions —
@@ -212,9 +237,6 @@ def jx_available_packed(
     mine_w: jnp.ndarray,  # [N, Wc] uint32 (receiver rows, packed)
     theirs_w: jnp.ndarray,  # [N, Wc] uint32 (peer rows, aligned)
     full_w: jnp.ndarray,  # [Wc] uint32 packed full masks
-    heads_mine: jnp.ndarray,  # [N, A] int32 (receiver heads)
-    aidx,
-    vidx,
     p: SimParams,
 ) -> jnp.ndarray:
     """[N, Wc] uint32: packed twin of :func:`jx_available` — the same
@@ -227,8 +249,15 @@ def jx_available_packed(
     - case 2 (gap, peer complete): complete ⇔ the lane of
       ``theirs XOR full`` is all-zero, so its ``lane_nonzero`` bit is
       CLEAR — complement against the lane-LSB mask;
-    - case 1 (above our head): per-changeset version/head compare (int32,
-      not maskable) packed onto lane LSBs via ``pack_flags``.
+    - case 1 (above our head): "no seen version >= ours within the
+      actor" — a suffix-OR of the seen flags along each actor's static
+      version positions, walked by pointer-jumping the
+      :func:`next_version_index` map on uint8 flags.  This replaces the
+      per-(node, actor) ``jx_heads`` segment-max + head gather the dense
+      path uses: at 10k nodes those materialized ~100 MB/round of int32
+      [N, K] tensors (the real whale behind BENCH_r07's bytes/round),
+      where the doubling walk is ``ceil(log2(max versions/actor))``
+      uint8 gather+OR passes.
 
     Padding lanes: full/theirs are both zero there, which reads as "peer
     complete" — harmless, since ``miss`` is zero on padding too.  Equals
@@ -241,10 +270,26 @@ def jx_available_packed(
     miss = theirs_w & ~mine_w
     has_any = pack.lane_nonzero(mine_w, bits)
     not_complete = pack.lane_nonzero(theirs_w ^ full_w[None, :], bits)
-    head_per_k = jnp.take_along_axis(
-        heads_mine, jnp.asarray(aidx)[None, :], axis=1
+    # seen flag per changeset: ANY coverage bit in the lane (a buffered
+    # partial raises the head even when seq 0 is still missing, matching
+    # jx_heads' cov > 0 rule) — gathered off has_any's lane-LSB flags
+    # (one fused gather+shift+mask; no [N, W, L] unpack temporaries)
+    kr = np.arange(p.n_changes)
+    kw = jnp.asarray((kr // pack.lanes_per_word(p)).astype(np.int32))
+    ksh = jnp.asarray((kr % pack.lanes_per_word(p)) * bits, dtype=np.uint32)
+    seen8 = ((has_any[:, kw] >> ksh[None, :]) & jnp.uint32(1)).astype(
+        jnp.uint8
     )
-    above_head = jnp.asarray(vidx)[None, :] > head_per_k
+    nxt, steps = next_version_index(p)
+    sfx = seen8  # OR over seen[k'] for same-actor k' >= k (incl. self)
+    jump = nxt
+    for _ in range(steps):
+        sfx = sfx | jnp.take(sfx, jnp.asarray(jump), axis=1)
+        jump = jump[jump]
+    # vidx[k] > head  ⇔  no same-actor version >= vidx[k] is seen; the
+    # self term makes this false whenever seen[k] — which has_any then
+    # serves, exactly the dense rule's case split
+    above_head = sfx == 0
     serve = pack.pack_flags(above_head, p) | has_any | (lsb & ~not_complete)
     return miss & pack.lane_fill(serve, bits)
 

@@ -16,6 +16,19 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compilation cache: the sim equivalence matrices
+# (test_sim*.py) compile hundreds of scan/while programs per run, and
+# compile time — not execution — dominates their wall clock.  The cache
+# dedupes identical programs across modules within one run and makes
+# repeat runs warm.  Via the env var so pytest-spawned CLI subprocesses
+# inherit it; a dir separate from bench.py's .jax_cache so its
+# cold/warm entry-count detection never sees test entries.
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_repo, ".jax_test_cache")
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 # The environment's TPU integration overrides jax_platforms at import time
 # (ignoring the env var), so pin it back to cpu right after import.
 import jax  # noqa: E402

@@ -622,6 +622,72 @@ def make_step(p, telemetry: bool = False):
     assert trace_rules(good) == set()
 
 
+# -- message frames: lint gate + sort+segment trace-safety fixtures -----------
+
+def test_cli_lint_frames_clean_at_warning():
+    """ISSUE 5 satellite: the frame layer and every module the framed
+    apply path touches hold the warning bar — sim/frames.py plus the
+    edited hot-path/accounting modules lint clean at --fail-on warning,
+    with no new suppressions."""
+    proc = cli_lint([
+        "--fail-on=warning",
+        "corrosion_tpu/sim/frames.py",
+        "corrosion_tpu/sim/model.py",
+        "corrosion_tpu/sim/pack.py",
+        "corrosion_tpu/sim/sync.py",
+        "corrosion_tpu/sim/cluster.py",
+        "corrosion_tpu/sim/profile.py",
+        "corrosion_tpu/sim/flight.py",
+    ])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_gl101_python_segment_walk_on_traced_keys():
+    # the bug segment_or exists to avoid: walking segment boundaries in
+    # Python over TRACED sort output (sk[i] is a tracer inside jit — the
+    # comparison is data-dependent control flow)
+    bad = """
+import jax, jax.numpy as jnp
+def apply_frame(keys, vals, n_out):
+    order = jnp.argsort(keys)
+    sk = jnp.take(keys, order)
+    sv = jnp.take(vals, order)
+    out = jnp.zeros((n_out,), jnp.uint32)
+    seg = 0
+    for i in range(sk.shape[0]):
+        if sk[i] != sk[i - 1]:
+            seg = i
+        out = out.at[sk[i]].set(out[sk[i]] | sv[i])
+    return out
+jax.jit(lambda k, v: apply_frame(k, v, 8))
+"""
+    assert "GL101" in trace_rules(bad)
+
+
+def test_frames_sort_segment_scan_idiom_not_flagged():
+    # the shipped idiom (sim/frames.py segment_or): argsort → segment
+    # boundary flags → associative OR-scan → scatter-max of the monotone
+    # prefixes; branch-free, explicit dtypes
+    good = """
+import jax, jax.numpy as jnp
+from jax import lax
+def seg_combine(a, b):
+    fa, va = a
+    fb, vb = b
+    return jnp.logical_or(fa, fb), jnp.where(fb, vb, va | vb)
+def segment_or(keys, vals, n_out: int):
+    order = jnp.argsort(keys)
+    sk = jnp.take(keys, order)
+    sv = jnp.take(vals, order, axis=0)
+    start = jnp.ones(sk.shape, dtype=bool).at[1:].set(sk[1:] != sk[:-1])
+    _, scanned = lax.associative_scan(seg_combine, (start, sv))
+    out = jnp.zeros((n_out,), dtype=jnp.uint32)
+    return out.at[sk].max(scanned)
+jax.jit(lambda k, v: segment_or(k, v, 8))
+"""
+    assert trace_rules(good) == set()
+
+
 # -- agent --self-check metric -----------------------------------------------
 
 def test_self_check_emits_lint_findings_total():

@@ -157,6 +157,59 @@ def test_rounds_to_fraction_quantiles():
     assert s["flight_sha256"] == flight.record_hash(rec)
 
 
+def test_compress_curve_roundtrip_and_tail():
+    # short runs stay scalar; a long flat tail collapses to [value, count]
+    curve = [0.1, 0.4, 0.8, 0.9] + [0.9984] * 244
+    comp = flight.compress_curve(curve)
+    assert comp == [0.1, 0.4, 0.8, 0.9, [0.9984, 244]]
+    assert flight.expand_curve(comp) == curve
+    # below-threshold runs round-trip unchanged (old BENCH files too)
+    short = [0.1, 0.5, 0.5, 0.5, 1.0]
+    assert flight.compress_curve(short) == short
+    assert flight.expand_curve(short) == short
+    assert flight.compress_curve([]) == []
+    # mid-curve plateaus compress as well as tails
+    plateau = [0.2] * 6 + [0.7, 1.0]
+    assert flight.compress_curve(plateau) == [[0.2, 6], 0.7, 1.0]
+    assert flight.expand_curve(flight.compress_curve(plateau)) == plateau
+
+
+def test_stalled_at_detection():
+    # converged records never stall
+    assert flight.stalled_at(_toy_record([0, 5, 10])) is None
+    # non-converged with a flat tail: stalled at the last change
+    stuck = _toy_record([0, 3, 7, 8, 8, 8, 8])
+    assert not stuck.converged
+    assert flight.stalled_at(stuck) == 4
+    # flat from round 1: stalled at round 1
+    assert flight.stalled_at(_toy_record([2, 2, 2])) == 1
+    # still changing at the horizon: "stalled" is the final round — the
+    # distinction a dashboard needs is carried by how far from the end
+    # the stamp sits (bench.py only stamps non-converged runs)
+    assert flight.stalled_at(_toy_record([0, 3, 7, 8])) == 4
+
+
+def test_convergence_section_stall_annotation(tmp_path):
+    import json as _json
+
+    rows = [
+        {"metric": "sim_100n_config2_convergence_wall", "rounds": 256,
+         "r50": 8, "r90": 10, "r99": 11, "stalled_at": 13,
+         "curve": [0.1, 0.9, [0.9984, 244]], "flight_sha256": "cd" * 32},
+    ]
+    bench = tmp_path / "bench.json"
+    bench.write_text("\n".join(_json.dumps(r) for r in rows) + "\n")
+    md = tmp_path / "BENCHMARKS.md"
+    md.write_text("# Benchmarks\n")
+    flight.update_benchmarks(str(bench), str(md))
+    doc = md.read_text()
+    assert "| 100n_config2 | 256 (stalled@13) |" in doc
+    # the RLE'd curve expands before sparklining: full-width flat tail
+    row_line = [ln for ln in doc.splitlines() if "100n_config2" in ln][0]
+    spark = row_line.split("`")[1]
+    assert len(spark) == 40
+
+
 def test_publish_metrics_gauges():
     from corrosion_tpu.utils.metrics import registry
 

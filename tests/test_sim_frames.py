@@ -1,0 +1,298 @@
+"""Frame/plane equivalence (sim/frames.py + the framed hot path).
+
+The framed broadcast replaces the dense per-chunk [N, K] scatter planes
+with bounded (target, kword, word) frames applied by sort + segmented
+OR (plus a plateau-gate ``lax.cond`` that skips the whole fanout on
+idle rounds).  That is a *rewrite of the apply kernel*, not of the
+round model, so the evidence required is bit-identity:
+
+1. the segment-OR kernel itself against a brute-force dict-of-ORs;
+2. framed vs dense on all five BASELINE configs: exact round counts,
+   full mid-flight AND final state equality, packed and unpacked;
+3. flight-recorder series field-for-field identical on the framed path
+   (telemetry must not perturb, and the framed telemetry must count
+   exactly what the dense path counts);
+4. a >= 20-draw property sweep over (seed, params) — lane geometries,
+   topologies, per-change vs shared draws, sync cadences — asserting
+   bit-identical state mid-flight and identical round counts;
+5. the same equivalence under an explicit chaos schedule with link
+   drops and duplicate injection (lowered drop planes must filter the
+   frames; dups are OR-absorbed by the segment combine);
+6. the static frame bounds/bytes used by sim/profile.py's accounting.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.chaos import GenParams, generate, lower
+from corrosion_tpu.sim import cluster, flight, frames, model, pack
+
+# -- the BASELINE configs at test scale (mirrors tests/test_sim_pack.py) ----
+
+
+def small_configs():
+    return {
+        "config1_ring3": model.config1_ring3(seed=7),
+        "config2_er": model.config2_er1k(seed=7).with_(
+            n_nodes=128, n_changes=16, max_rounds=128
+        ),
+        "config3_powerlaw": model.config3_powerlaw10k(seed=7).with_(
+            n_nodes=128, n_changes=16, write_rounds=4, max_rounds=256
+        ),
+        "config4_churn": model.config4_churn100k(seed=7).with_(
+            n_nodes=128, n_changes=16, write_rounds=4,
+            churn_rounds=6, max_rounds=256,
+        ),
+        "config5_partition": model.config5_partition100k(seed=7).with_(
+            n_nodes=128, n_changes=16, write_rounds=4,
+            partition_rounds=10, max_rounds=256,
+        ),
+    }
+
+
+def _state_equal(a, b):
+    assert len(a) == len(b)
+    for xa, xb in zip(a, b):
+        assert np.asarray(xa).dtype == np.asarray(xb).dtype
+        assert (np.asarray(xa) == np.asarray(xb)).all()
+
+
+# -- 1. the segment-OR kernel ------------------------------------------------
+
+
+@pytest.mark.parametrize("width", [None, 1, 5])
+def test_segment_or_matches_bruteforce(width):
+    rng = np.random.default_rng(17)
+    m, n_out = 257, 19  # deliberately not round numbers
+    keys = rng.integers(0, n_out, size=m).astype(np.int32)
+    shape = (m,) if width is None else (m, width)
+    vals = rng.integers(0, 1 << 32, size=shape, dtype=np.uint64).astype(
+        np.uint32
+    )
+    got = np.asarray(
+        frames.segment_or(jnp.asarray(keys), jnp.asarray(vals), n_out)
+    )
+    expect = np.zeros((n_out,) + shape[1:], dtype=np.uint32)
+    for k, v in zip(keys, vals):
+        expect[k] |= v
+    assert (got == expect).all()
+
+
+def test_segment_or_empty_segments_are_zero():
+    keys = jnp.asarray(np.full(8, 3, dtype=np.int32))
+    vals = jnp.asarray(np.arange(1, 9, dtype=np.uint32))
+    out = np.asarray(frames.segment_or(keys, vals, 6))
+    assert out[3] == np.bitwise_or.reduce(np.arange(1, 9, dtype=np.uint32))
+    assert (np.delete(out, 3) == 0).all()
+
+
+def test_identity_frame_apply_is_masked_or():
+    rng = np.random.default_rng(3)
+    dst = rng.integers(0, 1 << 32, size=(9, 4), dtype=np.uint64).astype(
+        np.uint32
+    )
+    rows = rng.integers(0, 1 << 32, size=(9, 4), dtype=np.uint64).astype(
+        np.uint32
+    )
+    ok = rng.integers(0, 2, size=9).astype(bool)
+    got = np.asarray(
+        frames.identity_frame_apply(
+            jnp.asarray(dst), jnp.asarray(ok), jnp.asarray(rows)
+        )
+    )
+    expect = np.where(ok[:, None], dst | rows, dst)
+    assert (got == expect).all()
+
+
+# -- 2. five BASELINE configs: framed == dense, packed and unpacked ---------
+
+
+@pytest.mark.parametrize("packed", [True, False])
+@pytest.mark.parametrize("name", list(small_configs()))
+def test_framed_matches_dense_exactly(name, packed):
+    p = small_configs()[name].with_(packed=packed)
+    dense = cluster.run(p, return_state=True)
+    framed = cluster.run(p.with_(framed=True), return_state=True)
+    assert framed.converged == dense.converged
+    assert framed.rounds == dense.rounds, (
+        f"{name}: framed rounds diverged "
+        f"framed={framed.rounds} dense={dense.rounds}"
+    )
+    _state_equal(framed.state, dense.state)
+
+
+@pytest.mark.parametrize("packed", [True, False])
+def test_framed_mid_flight_state_equality(packed):
+    """Stepping framed and dense side by side: full state equality at a
+    pre-convergence round AND at convergence (stronger than round
+    counts — every plane, every round layout)."""
+    p = small_configs()["config4_churn"].with_(packed=packed)
+    ref_rounds = cluster.run(p).rounds
+    step_d = jax.jit(cluster.make_step(p))
+    step_f = jax.jit(cluster.make_step(p.with_(framed=True)))
+    sd, sf = cluster.init_state(p), cluster.init_state(p.with_(framed=True))
+    probes = {max(1, ref_rounds // 2), ref_rounds}
+    for r in range(1, ref_rounds + 1):
+        sd, sf = step_d(sd), step_f(sf)
+        if r in probes:
+            _state_equal(sf, sd)
+            assert int(sf[4]) == r
+
+
+# -- 3. flight series field-for-field identical -----------------------------
+
+
+@pytest.mark.parametrize("packed", [True, False])
+def test_framed_flight_series_identical(packed):
+    p = small_configs()["config4_churn"].with_(packed=packed)
+    a = cluster.run(p, record=True)
+    b = cluster.run(p.with_(framed=True), record=True)
+    assert b.flight.rounds == a.flight.rounds
+    for f in flight.TELEMETRY_FIELDS:
+        assert b.flight.series[f] == a.flight.series[f], (f, packed)
+    assert flight.record_hash(b.flight) == flight.record_hash(a.flight)
+
+
+# -- 4. >= 20-draw property sweep over (seed, params) -----------------------
+
+
+def _draw_params(i: int) -> model.SimParams:
+    """Deterministic params draw i — sweeps lane geometries (1/2/4/8-bit
+    cov lanes), shared vs per-change fanout, topologies, sync cadence
+    and budget, churn and partitions."""
+    rng = np.random.default_rng(1000 + i)
+    nseq = int(rng.choice([1, 2, 3, 4, 8]))
+    topo = [model.COMPLETE, model.COMPLETE, model.ER][i % 3]
+    return model.SimParams(
+        n_nodes=int(rng.integers(12, 28)),
+        n_changes=int(rng.integers(5, 18)),
+        fanout=int(rng.integers(1, 4)),
+        max_transmissions=int(rng.choice([2, 3, 5])),
+        sync_interval=int(rng.choice([0, 2, 3])),
+        sync_chunk_budget=int(rng.choice([0, 3])),
+        write_rounds=int(rng.integers(1, 4)),
+        max_rounds=96,
+        nseq_max=nseq,
+        fanout_per_change=bool(i % 2),
+        topology=topo,
+        er_degree=6,
+        swim=bool(rng.integers(0, 2)),
+        churn_ppm=int(rng.choice([0, 40_000])),
+        churn_rounds=6,
+        partition_frac_ppm=int(rng.choice([0, 300_000])),
+        partition_rounds=5,
+        seed=int(rng.integers(0, 1 << 16)),
+    )
+
+
+@pytest.mark.parametrize("i", range(20))
+def test_framed_property_sweep(i):
+    p = _draw_params(i)
+    packed = p.with_(packed=True)
+    # round counts + final state, packed
+    dense = cluster.run(packed, return_state=True)
+    framed = cluster.run(packed.with_(framed=True), return_state=True)
+    assert framed.rounds == dense.rounds, p
+    assert framed.converged == dense.converged, p
+    _state_equal(framed.state, dense.state)
+    if i % 5 == 0:
+        # mid-flight packed state bit-identity: step side by side well
+        # short of convergence.  A subset of draws — the full-run check
+        # above already pins every draw's dynamics through the final
+        # state, and the two extra step compiles per draw dominate the
+        # sweep's wall clock (the suite has a hard tier-1 time budget)
+        step_d = jax.jit(cluster.make_step(packed))
+        step_f = jax.jit(cluster.make_step(packed.with_(framed=True)))
+        sd = cluster.init_state(packed)
+        sf = cluster.init_state(packed.with_(framed=True))
+        for _ in range(min(6, max(2, dense.rounds - 1))):
+            sd, sf = step_d(sd), step_f(sf)
+        _state_equal(sf, sd)
+    if i % 4 == 0:  # unpacked layout spot checks across the sweep
+        du = cluster.run(p, return_state=True)
+        fu = cluster.run(p.with_(framed=True), return_state=True)
+        assert fu.rounds == du.rounds, p
+        _state_equal(fu.state, du.state)
+
+
+# -- 5. equivalence under a chaos schedule with drop + dup ------------------
+
+CHAOS_GP = GenParams(
+    n_nodes=24, n_rounds=48, seed=3,
+    partition_frac_ppm=250_000, partition_rounds=6,
+    crash_ppm=40_000, crash_rounds=3, crash_down_rounds=3,
+    drop_ppm=120_000, drop_rounds=10,
+    duplicate_ppm=120_000,
+)
+
+
+@pytest.mark.parametrize("packed", [True, False])
+def test_framed_matches_dense_under_chaos_drop_dup(packed):
+    sched = generate(CHAOS_GP)
+    assert any(e.kind == "link" for e in sched.events), "want drop events"
+    p = model.SimParams(
+        n_nodes=24, n_changes=12, fanout=2, max_transmissions=2,
+        sync_interval=3, write_rounds=3, max_rounds=CHAOS_GP.n_rounds,
+        nseq_max=2, seed=5, swim=True, packed=packed,
+    )
+    lw = lower(sched, horizon=p.max_rounds)
+    dense = cluster.run(p, chaos=lw, return_state=True)
+    framed = cluster.run(p.with_(framed=True), chaos=lw, return_state=True)
+    assert framed.rounds == dense.rounds
+    assert framed.converged == dense.converged
+    _state_equal(framed.state, dense.state)
+
+
+# -- 6. the plateau gate and the static frame bounds ------------------------
+
+
+def test_plateau_gate_idle_round_is_noop():
+    """A round with no held-and-budgeted chunks anywhere takes the
+    cond's skip branch: state advances only by the round counter and
+    must match the dense step exactly."""
+    p = small_configs()["config1_ring3"].with_(packed=True, swim=False)
+    sf = cluster.init_state(p.with_(framed=True))
+    # place the state past every inject round with all budgets spent:
+    # cov full, budget zero — no traffic, but sync/probe phases still run
+    full_w = jnp.asarray(pack.full_masks_packed(p))
+    sf = (
+        jnp.broadcast_to(full_w, sf[0].shape).astype(jnp.uint32),
+        jnp.zeros_like(sf[1]),
+        sf[2],
+        sf[3],
+        jnp.int32(p.write_rounds + 1),
+    )
+    step_f = jax.jit(cluster.make_step(p.with_(framed=True)))
+    step_d = jax.jit(cluster.make_step(p))
+    _state_equal(step_f(sf), step_d(sf))
+
+
+def test_frame_bounds_and_bytes():
+    p = model.SimParams(
+        n_nodes=100, n_changes=64, fanout=3, max_transmissions=2,
+        sync_interval=5, write_rounds=1, max_rounds=8, nseq_max=4, seed=0,
+        fanout_per_change=False,
+    )
+    wc = pack.cov_words(p)
+    rows = 4 * 3 * 100
+    assert frames.row_frame_rows(p) == rows
+    assert frames.entry_frame_entries(p) == rows * 64
+    assert frames.sync_frame_rows(p) == 100
+    assert frames.sync_frame_rows(p.with_(sync_interval=0)) == 0
+    # shared-draw: Wc words + one int32 key per row, plus the sync rows
+    assert frames.frame_bytes_per_round(p) == rows * wc * 4 + rows * 4 + 100 * wc * 4
+    pe = p.with_(fanout_per_change=True)
+    assert (
+        frames.frame_bytes_per_round(pe)
+        == rows * 64 * 8 + 100 * wc * 4
+    )
+    b = frames.frame_budget(p)
+    assert b["rows"] == rows
+    assert b["frame_bytes_per_round"] == frames.frame_bytes_per_round(p)
+    # the frame replaces dense [N, K] scatter planes: at bench scale the
+    # bound must be far below one boolean plane per chunk slot
+    big = model.config4_churn100k(seed=0).with_(n_nodes=10_000)
+    dense_planes = big.n_nodes * big.n_changes * max(1, big.nseq_max)
+    assert frames.frame_bytes_per_round(big) < dense_planes

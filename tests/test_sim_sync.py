@@ -134,6 +134,87 @@ def test_jax_twins_match_scalar(trial):
             )
 
 
+@pytest.mark.parametrize("trial", range(10))
+def test_available_packed_matches_dense(trial):
+    # the packed twin earns bit-equality with pack(jx_available(...)) on
+    # random coverage — which routinely contains partial versions whose
+    # seq-0 bit is CLEAR, the case that distinguishes "head raised by a
+    # buffered partial" (cov > 0) from "seq 0 seen" in the suffix-OR
+    from corrosion_tpu.sim import pack
+
+    rng = random.Random(3000 + trial)
+    p = make_params(seed=trial, n_nodes=9, n_changes=12)
+    aidx, vidx, n_actors = s.actor_index(p)
+    full = s.full_masks(p)
+    N = 6
+    cov = np.array([random_cov(p, rng) for _ in range(N)], dtype=np.uint8)
+    theirs = np.array([random_cov(p, rng) for _ in range(N)], dtype=np.uint8)
+
+    heads = s.jx_heads(jnp.asarray(cov), aidx, vidx, n_actors)
+    dense = s.jx_available(
+        jnp.asarray(cov), jnp.asarray(theirs), jnp.asarray(full),
+        heads, aidx, vidx,
+    )
+    packed = s.jx_available_packed(
+        pack.pack_cov(jnp.asarray(cov), p),
+        pack.pack_cov(jnp.asarray(theirs), p),
+        jnp.asarray(pack.full_masks_packed(p)),
+        p,
+    )
+    assert np.array_equal(
+        np.asarray(packed), np.asarray(pack.pack_cov(dense, p))
+    )
+
+
+def test_available_packed_partial_above_gap():
+    # the corner the random draws can miss: our only coverage of the
+    # higher version is a partial WITHOUT seq 0, the lower version of the
+    # same actor is a gap, and the peer's copy of the gap is incomplete.
+    # The head rule says the partial raises our head past the gap, so the
+    # gap is NOT served (case 2, peer partial); a seq-0-only seen flag
+    # would misread the gap as above-head and serve it
+    from corrosion_tpu.sim import pack
+
+    p = make_params(seed=0, n_nodes=4, n_changes=10, nseq_max=4)
+    aidx, vidx, n_actors = s.actor_index(p)
+    full = s.full_masks(p)
+    # same-actor (k, k') pair with vidx[k] < vidx[k']
+    pair = None
+    for k in range(p.n_changes):
+        for k2 in range(p.n_changes):
+            if int(aidx[k]) == int(aidx[k2]) and int(vidx[k]) < int(vidx[k2]):
+                # k2 chunked (a seq bit above 0 exists, so "partial
+                # missing seq 0" is expressible), and k chunked (so the
+                # peer's single seq-0 bit is NOT a complete copy)
+                if int(full[k2]) & ~1 and int(full[k]) != 1:
+                    pair = (k, k2)
+                    break
+        if pair:
+            break
+    assert pair is not None, "config has no chunked same-actor pair"
+    k, k2 = pair
+    cov = np.zeros((1, p.n_changes), dtype=np.uint8)
+    cov[0, k2] = int(full[k2]) & ~1 & 0xFF  # partial, seq 0 missing
+    theirs = np.zeros((1, p.n_changes), dtype=np.uint8)
+    theirs[0, k] = 1  # peer partial at our gap
+
+    heads = s.jx_heads(jnp.asarray(cov), aidx, vidx, n_actors)
+    dense = s.jx_available(
+        jnp.asarray(cov), jnp.asarray(theirs), jnp.asarray(full),
+        heads, aidx, vidx,
+    )
+    assert int(np.asarray(dense)[0, k]) == 0  # head rule: not served
+    packed = s.jx_available_packed(
+        pack.pack_cov(jnp.asarray(cov), p),
+        pack.pack_cov(jnp.asarray(theirs), p),
+        jnp.asarray(pack.full_masks_packed(p)),
+        p,
+    )
+    assert np.array_equal(
+        np.asarray(packed), np.asarray(pack.pack_cov(dense, p))
+    )
+
+
 def test_popcount_and_lowest_bits_tables():
     for m in range(256):
         assert s.py_popcount8(m) == bin(m).count("1")

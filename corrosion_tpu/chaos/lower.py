@@ -33,7 +33,7 @@ from __future__ import annotations
 
 from collections import defaultdict
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -98,6 +98,63 @@ class LoweredChaos:
                 "schedule carries clock_skew events; the sim has no SWIM "
                 "wall clock to skew (runtime only)"
             )
+
+    @classmethod
+    def stack(
+        cls, lowered: Sequence["LoweredChaos"]
+    ) -> Tuple[Dict[str, np.ndarray], List[str]]:
+        """Batch B sim-lowerable schedules of equal shape into ONE plane
+        pytree for the fleet vmap axis (fleet/run.py), plus the per-lane
+        ``schedule_hash`` values for the FLEET artifact's chaos
+        provenance (today the hash only exists per-schedule).
+
+        Returns ``(planes, hashes)`` where ``planes`` maps the
+        ``chaos_arrays`` keys of ``sim/cluster.make_step`` to arrays with
+        a leading scenario axis: ``part_side`` int8[B, N], ``part_active``
+        bool[B, R], ``dead``/``restart`` bool[B, R, N], ``seed``
+        uint32[B], plus ``die`` bool[B, R, N] when any lane crashes and
+        ``drop_ppm`` int32[B, R, N, N] when any lane drops links — lanes
+        without that fault ride exact zero planes (a zero plane is a
+        bit-exact no-op in the step, so mixed fleets stay lane-identical
+        to their solo runs).  Duplicate-link planes are NOT stacked: the
+        sim's coverage masks OR-absorb duplicates, so ``dup_ppm`` only
+        matters to the runtime injector."""
+        assert lowered, "stack() of an empty schedule list"
+        R = lowered[0].horizon
+        N = lowered[0].n_nodes
+        for lo in lowered:
+            lo.require_sim_lowerable()
+            if lo.horizon != R:
+                raise ValueError(
+                    "stack() needs equal horizons: lower every schedule "
+                    f"with the same horizon= (got {lo.horizon} != {R})"
+                )
+            if lo.n_nodes != N:
+                raise ValueError(
+                    f"stack() across cluster sizes ({lo.n_nodes} != {N})"
+                )
+        planes: Dict[str, np.ndarray] = {
+            "part_side": np.stack([lo.part_side for lo in lowered]),
+            "part_active": np.stack([lo.part_active for lo in lowered]),
+            "dead": np.stack([lo.dead for lo in lowered]),
+            "restart": np.stack([lo.restart for lo in lowered]),
+            "seed": np.asarray(
+                [lo.schedule.seed & 0xFFFFFFFF for lo in lowered],
+                dtype=np.uint32,
+            ),
+        }
+        if any(lo.any_die() for lo in lowered):
+            planes["die"] = np.stack([lo.die for lo in lowered])
+        if any(lo.drop_ppm is not None for lo in lowered):
+            zero = np.zeros((R, N, N), dtype=np.int32)
+            planes["drop_ppm"] = np.stack(
+                [
+                    zero if lo.drop_ppm is None else lo.drop_ppm
+                    for lo in lowered
+                ]
+            )
+        hashes = [lo.schedule.schedule_hash() for lo in lowered]
+        return planes, hashes
 
     def summarize(self) -> Dict[str, int]:
         """Event-count summary for CLI output / metrics."""

@@ -525,6 +525,110 @@ async def cmd_sim(args) -> int:
     return 2
 
 
+async def cmd_fleet(args) -> int:
+    """``fleet run|tune`` — one-compile vmapped scenario sweeps and the
+    gossip-parameter tuner (doc/simulator.md "Scenario fleets").  Needs
+    no config file: fleets run entirely inside the simulator."""
+    import json as _json
+
+    from ..fleet import batch
+    from ..fleet import run as fleetrun
+    from ..sim.model import CONFIGS
+
+    def _ints(text: str) -> List[int]:
+        return [int(x) for x in text.split(",") if x.strip() != ""]
+
+    p = CONFIGS[args.baseline](seed=args.seed)
+    if args.scale != 1.0:
+        p = p.with_(n_nodes=max(8, int(p.n_nodes * args.scale)))
+    p = p.with_(packed=not args.unpacked)
+    fanouts = _ints(args.fanouts) if args.fanouts else [p.fanout]
+    mts = _ints(args.max_tx) if args.max_tx else [p.max_transmissions]
+    sis = (
+        _ints(args.sync_intervals)
+        if args.sync_intervals
+        else [p.sync_interval]
+    )
+
+    if args.fleet_cmd == "run":
+        scenarios = [
+            p.with_(
+                fanout=fo,
+                max_transmissions=mt,
+                sync_interval=si,
+                seed=args.seed + k,
+            )
+            for fo in fanouts
+            for mt in mts
+            for si in sis
+            for k in range(args.scenarios)
+        ]
+        p_static, sweep = batch.split(scenarios)
+        res = fleetrun.run_fleet(p_static, sweep)
+        fleetrun.publish_metrics(res)
+        if args.out:
+            fleetrun.write_artifact(res, args.out)
+            print(f"wrote {args.out}", file=sys.stderr)
+        conv = res.bytes_to_convergence[res.converged]
+        print(
+            _json.dumps(
+                {
+                    "n_scenarios": res.n_scenarios,
+                    "converged": int(res.converged.sum()),
+                    "rounds_min": int(res.rounds.min()),
+                    "rounds_max": int(res.rounds.max()),
+                    "bytes_to_convergence_min": (
+                        int(conv.min()) if conv.size else None
+                    ),
+                    "compile_s": round(res.compile_s, 3),
+                    "wall_s": round(res.wall_s, 3),
+                },
+                sort_keys=True,
+                indent=2,
+            )
+        )
+        return 0 if bool(res.converged.all()) else 1
+
+    if args.fleet_cmd == "tune":
+        from ..fleet.tune import frontier_markdown, tune
+
+        res = tune(
+            p,
+            fanouts=fanouts,
+            max_transmissions=mts,
+            sync_intervals=sis,
+            seeds_per_point=args.seeds_per_point,
+            eta=args.eta,
+            max_rungs=args.rungs,
+        )
+        print(frontier_markdown(res))
+        if res.recommended is None:
+            print("no operating point converged on every seed", file=sys.stderr)
+            return 1
+        rec = res.recommended
+        print(
+            _json.dumps(
+                {
+                    "recommended": {
+                        "fanout": rec.fanout,
+                        "max_transmissions": rec.max_transmissions,
+                        "sync_interval": rec.sync_interval,
+                    },
+                    "mean_bytes": rec.mean_bytes,
+                    "mean_rounds": rec.mean_rounds,
+                    "rungs": res.rungs,
+                    "compiles": res.compiles,
+                },
+                sort_keys=True,
+                indent=2,
+            )
+        )
+        return 0
+
+    _die(f"unknown fleet subcommand {args.fleet_cmd!r}")
+    return 2
+
+
 def _cell_str(cell: Any) -> str:
     if cell is None:
         return ""
@@ -745,6 +849,55 @@ def build_parser() -> argparse.ArgumentParser:
                     help="summarize an existing NDJSON artifact instead "
                     "of running")
     sp.set_defaults(fn=cmd_sim)
+
+    sp = sub.add_parser(
+        "fleet",
+        help="one-compile vmapped scenario sweeps + gossip-parameter "
+        "tuner (doc/simulator.md)",
+    )
+    fsub = sp.add_subparsers(dest="fleet_cmd", required=True)
+    for name, hlp in (
+        ("run", "run a scenario fleet as ONE compiled program"),
+        (
+            "tune",
+            "successive-halving search for the minimum-bytes converging "
+            "operating point",
+        ),
+    ):
+        fp = fsub.add_parser(name, help=hlp)
+        fp.add_argument(
+            "--baseline",
+            type=int,
+            default=3,
+            choices=(1, 2, 3, 4, 5),
+            help="BASELINE config number (sim/model.py CONFIGS)",
+        )
+        fp.add_argument("--scale", type=float, default=1.0,
+                        help="scale n_nodes by this factor (min 8)")
+        fp.add_argument("--seed", type=int, default=0,
+                        help="base seed; lanes use seed, seed+1, ...")
+        fp.add_argument("--unpacked", action="store_true",
+                        help="run the unpacked hot path (packed is default)")
+        fp.add_argument("--fanouts", default=None,
+                        help="comma list (default: the config's fanout)")
+        fp.add_argument("--max-tx", default=None,
+                        help="comma list of max_transmissions values")
+        fp.add_argument("--sync-intervals", default=None,
+                        help="comma list of sync_interval values")
+        if name == "run":
+            fp.add_argument(
+                "--scenarios", type=int, default=8,
+                help="seeds per knob point (lanes = points × scenarios)",
+            )
+            fp.add_argument("-o", "--out", default=None,
+                            help="write the FLEET_r*.json artifact here")
+        else:
+            fp.add_argument("--seeds-per-point", type=int, default=2)
+            fp.add_argument("--eta", type=int, default=2,
+                            help="halving rate (keep top 1/eta per rung)")
+            fp.add_argument("--rungs", type=int, default=3,
+                            help="max successive-halving rungs")
+    sp.set_defaults(fn=cmd_fleet)
 
     sp = sub.add_parser("tls", help="certificate generation")
     tsub = sp.add_subparsers(dest="tls_cmd", required=True)

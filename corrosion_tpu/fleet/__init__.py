@@ -1,0 +1,27 @@
+"""Scenario fleets: one-compile vmapped sweeps + a gossip-parameter tuner.
+
+``batch.split`` partitions B scenarios into one static program plus
+traced sweep vectors, ``run.run_fleet`` executes them as a single
+``jax.jit(jax.vmap(...))`` device program (every lane bit-identical to a
+solo ``cluster.run``), and ``tune.tune`` runs successive halving over
+fleet batches to find the minimum-bytes converging operating point.
+"""
+
+from .batch import SWEPT_FIELDS, SweepParams, lane_params, split
+from .run import FleetResult, publish_metrics, run_fleet, write_artifact
+from .tune import TunePoint, TuneResult, frontier_markdown, tune
+
+__all__ = [
+    "SWEPT_FIELDS",
+    "SweepParams",
+    "lane_params",
+    "split",
+    "FleetResult",
+    "run_fleet",
+    "publish_metrics",
+    "write_artifact",
+    "TunePoint",
+    "TuneResult",
+    "frontier_markdown",
+    "tune",
+]

@@ -1,0 +1,172 @@
+"""Split B scenarios into one static program + traced sweep vectors.
+
+A fleet (fleet/run.py) runs B scenarios as ONE compiled device program:
+``jax.vmap`` over a scenario axis, ``jax.jit`` once.  That only works if
+every scenario traces to the SAME program — so :func:`split` partitions
+``SimParams`` into
+
+- **shape statics**, which must agree across every lane and bake into
+  the executable: ``n_nodes``, ``n_changes``, ``nseq_max``,
+  ``topology`` (+ its degree knobs), ``max_rounds``, ``packed`` /
+  ``framed``, the SWIM/churn/partition structure — everything that
+  decides tensor shapes or which phases exist; and
+- **sweep values**, which ride the vmap axis as traced int32/uint32
+  scan operands (sim/cluster.py ``Knobs``): ``seed``, ``fanout``,
+  ``max_transmissions``, ``sync_interval``, ``write_rounds``, plus an
+  optional stacked chaos-plane pytree
+  (:meth:`corrosion_tpu.chaos.LoweredChaos.stack`).
+
+Two sweep knobs are *structural ceilings* as well as traced values: the
+static program unrolls ``max(fanout)`` draw slots (lanes gate surplus
+slots off, sim/cluster.py ``slot_on``) and builds the anti-entropy
+machinery iff ``max(sync_interval) > 0``.  ``split`` computes those
+maxima into the returned static params.  The packed budget lane width is
+a layout static too (2-bit lanes iff ``max_transmissions <= 3``,
+sim/pack.py), so a packed fleet mixing lanes across that boundary stores
+identical budget VALUES in different word layouts than the lanes' solo
+runs — canonicalize with ``pack.unpack_budget`` before comparing raw
+words (fleet/run.py's convergence/rounds outputs are layout-free).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields as dc_fields
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..sim.model import SimParams
+
+# the five gossip knobs that become traced scan operands (cluster.Knobs
+# field order); everything else in SimParams is a shape static
+SWEPT_FIELDS = (
+    "seed",
+    "fanout",
+    "max_transmissions",
+    "sync_interval",
+    "write_rounds",
+)
+
+
+@dataclass
+class SweepParams:
+    """[B] sweep vectors for one fleet batch (+ optional stacked chaos).
+
+    ``chaos_planes`` / ``schedule_hashes`` come from
+    ``LoweredChaos.stack`` and carry per-lane fault schedules and their
+    provenance hashes into the fleet artifact."""
+
+    seed: np.ndarray  # uint32[B]
+    fanout: np.ndarray  # int32[B]
+    max_transmissions: np.ndarray  # int32[B]
+    sync_interval: np.ndarray  # int32[B]
+    write_rounds: np.ndarray  # int32[B]
+    chaos_planes: Optional[Dict[str, np.ndarray]] = None
+    schedule_hashes: Optional[List[str]] = None
+
+    @property
+    def n_scenarios(self) -> int:
+        return int(self.seed.shape[0])
+
+    def lane(self, i: int) -> Dict[str, int]:
+        """Lane i's swept values as Python ints (solo-oracle kwargs)."""
+        return {f: int(getattr(self, f)[i]) for f in SWEPT_FIELDS}
+
+
+def split(
+    scenarios: Sequence[SimParams],
+    chaos: Optional[Sequence] = None,
+) -> Tuple[SimParams, SweepParams]:
+    """(static params, sweep vectors) for one fleet batch.
+
+    Every non-swept ``SimParams`` field must agree across the scenarios
+    (they select program structure, not operand values); a mismatch
+    raises ``ValueError`` naming the field.  ``chaos`` is an optional
+    per-lane list of ``LoweredChaos`` (equal horizons, all
+    sim-lowerable) stacked onto the sweep.  The returned static params
+    carry the ceiling values (max fanout / max_transmissions /
+    sync_interval / write_rounds), so constructing them re-runs
+    ``SimParams`` validation at the fleet's widest point — a packed
+    fleet with any lane above the 4-bit budget cap fails here, not mid-
+    trace."""
+    assert scenarios, "split() of an empty scenario list"
+    base = scenarios[0]
+    static_fields = [
+        f.name for f in dc_fields(SimParams) if f.name not in SWEPT_FIELDS
+    ]
+    for p in scenarios[1:]:
+        for name in static_fields:
+            if getattr(p, name) != getattr(base, name):
+                raise ValueError(
+                    f"scenario field {name!r} is a shape static and must "
+                    f"agree across the fleet: {getattr(p, name)!r} != "
+                    f"{getattr(base, name)!r} — run it as a separate fleet"
+                )
+    for p in scenarios:
+        if p.fanout < 1:
+            raise ValueError(f"fanout must be >= 1; got {p.fanout}")
+        if p.fanout >= p.n_nodes:
+            raise ValueError(
+                f"fanout {p.fanout} needs {p.fanout} distinct non-self "
+                f"targets; n_nodes={p.n_nodes}"
+            )
+        if p.write_rounds < 1:
+            raise ValueError(
+                f"write_rounds must be >= 1; got {p.write_rounds}"
+            )
+        if p.sync_interval < 0:
+            raise ValueError(
+                f"sync_interval must be >= 0; got {p.sync_interval}"
+            )
+    p_static = base.with_(
+        fanout=max(p.fanout for p in scenarios),
+        max_transmissions=max(p.max_transmissions for p in scenarios),
+        sync_interval=max(p.sync_interval for p in scenarios),
+        write_rounds=max(p.write_rounds for p in scenarios),
+    )
+    chaos_planes = None
+    hashes = None
+    if chaos is not None:
+        from ..chaos.lower import LoweredChaos
+
+        if len(chaos) != len(scenarios):
+            raise ValueError(
+                f"chaos list length {len(chaos)} != scenario count "
+                f"{len(scenarios)}"
+            )
+        chaos_planes, hashes = LoweredChaos.stack(list(chaos))
+        if chaos_planes["dead"].shape[2] != base.n_nodes:
+            raise ValueError(
+                "chaos schedules sized for another cluster: "
+                f"{chaos_planes['dead'].shape[2]} != {base.n_nodes}"
+            )
+        if chaos_planes["dead"].shape[1] < base.max_rounds:
+            raise ValueError(
+                f"chaos horizon {chaos_planes['dead'].shape[1]} < "
+                f"max_rounds {base.max_rounds}: lower every schedule "
+                "with horizon=max_rounds"
+            )
+    sweep = SweepParams(
+        seed=np.asarray(
+            [p.seed & 0xFFFFFFFF for p in scenarios], dtype=np.uint32
+        ),
+        fanout=np.asarray([p.fanout for p in scenarios], dtype=np.int32),
+        max_transmissions=np.asarray(
+            [p.max_transmissions for p in scenarios], dtype=np.int32
+        ),
+        sync_interval=np.asarray(
+            [p.sync_interval for p in scenarios], dtype=np.int32
+        ),
+        write_rounds=np.asarray(
+            [p.write_rounds for p in scenarios], dtype=np.int32
+        ),
+        chaos_planes=chaos_planes,
+        schedule_hashes=hashes,
+    )
+    return p_static, sweep
+
+
+def lane_params(p_static: SimParams, sweep: SweepParams, i: int) -> SimParams:
+    """Reconstruct lane i's solo ``SimParams`` — the oracle a fleet lane
+    must match bit for bit (tests/test_sim_fleet.py)."""
+    return p_static.with_(**sweep.lane(i))

@@ -1,0 +1,346 @@
+"""Run B scenarios as one compiled vmapped device program.
+
+``run_fleet`` wraps the flight recorder's done-gated ``lax.scan``
+(sim/flight.py) in ``jax.jit(jax.vmap(...))``: each lane's sweep knobs
+arrive as traced int32 scalars (sim/cluster.py ``Knobs``), the optional
+chaos plane stack rides the same vmap axis, and the whole fleet costs
+ONE compile — the point of ROADMAP item 3, since cold compile dominates
+any per-point sweep (~6 s compile vs 0.3 s execute on config 3,
+BENCH_r06).  Under ``vmap`` the done-gate's ``lax.cond`` lowers to a
+``select`` (both branches execute per lane), which is safe here: the
+step is stateless outside its carry and the counter RNG consumes no
+state, so running a frozen lane's step and discarding it perturbs
+nothing — the graftlint GL101 fixture for this idiom lives in
+tests/test_lint.py.
+
+Outputs per lane: convergence round (bit-identical to a solo
+``cluster.run()`` with the lane's params — the solo path stays the
+oracle, tests/test_sim_fleet.py), converged flag, ``stalled_at`` label
+for budget-exhausted lanes, the ``[B, R, 15]`` telemetry block over
+:data:`~corrosion_tpu.sim.model.TELEMETRY_FIELDS`, RLE'd coverage
+curves, and the modeled bytes-to-convergence (sim/profile.py byte
+model) the tuner ranks by.  ``write_artifact`` stamps it all into a
+``FLEET_r*.json`` artifact with per-lane chaos ``schedule_hash``
+provenance.
+
+Memory: the fleet carry is B solo carries, so budget
+``B × live_state_bytes(p)`` (sim/profile.py) plus the step transients
+per lane — doc/simulator.md tabulates the B×N frontier.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..sim import cluster
+from ..sim import flight as flightmod
+from ..sim import profile as profilemod
+from ..sim.model import TELEMETRY_FIELDS, SimParams
+from .batch import SweepParams
+
+__all__ = ["FleetResult", "run_fleet", "publish_metrics", "write_artifact"]
+
+
+@dataclass
+class FleetResult:
+    """One fleet batch: per-lane outcomes + the batched telemetry block."""
+
+    p_static: SimParams
+    sweep: SweepParams
+    rounds: np.ndarray  # int32[B] convergence round (== solo rounds)
+    converged: np.ndarray  # bool[B]
+    stalled_at: List[Optional[int]]  # per lane; None when converged
+    telemetry: np.ndarray  # int32[B, R, len(TELEMETRY_FIELDS)]
+    bytes_to_convergence: np.ndarray  # int64[B] modeled traffic bytes
+    curves: List[List[object]]  # RLE'd per-lane coverage curves
+    wall_s: float
+    compile_s: float
+    state: Optional[tuple] = None  # stacked final state when requested
+    schedule_hashes: Optional[List[str]] = None
+
+    @property
+    def n_scenarios(self) -> int:
+        return int(self.rounds.shape[0])
+
+
+def run_fleet(
+    p_static: SimParams,
+    sweep: SweepParams,
+    return_state: bool = False,
+    n_rounds: Optional[int] = None,
+) -> FleetResult:
+    """Execute one fleet batch (one compile, B lanes).
+
+    ``p_static``/``sweep`` come from :func:`fleet.batch.split`; the
+    sweep's optional ``chaos_planes`` stack is vmapped alongside the
+    knob vectors.  Timing is split compile/execute like
+    ``cluster.run``.  ``n_rounds`` bounds the scan horizon below
+    ``max_rounds`` (bench.py --fleet passes a measured bound so 64
+    lanes don't idle to config 3's 512-round ceiling; under ``vmap``
+    the done-gate is a ``select``, so every lane pays every scanned
+    round)."""
+    B = sweep.n_scenarios
+    R = p_static.max_rounds if n_rounds is None else n_rounds
+    zeros = {f: jnp.int32(0) for f in TELEMETRY_FIELDS}
+    has_chaos = sweep.chaos_planes is not None
+
+    def lane(kv, chaos_lane=None):
+        kn = cluster.Knobs(*kv)
+        step = cluster.make_step(
+            p_static, telemetry=True, knobs=kn, chaos_arrays=chaos_lane
+        )
+        full = cluster.full_plane_for(p_static, kn.seed)
+
+        def body(state, _):
+            done = (state[0] == full[None, :]).all()
+            return lax.cond(done, lambda s: (s, zeros), step, state)
+
+        return lax.scan(
+            body, cluster.init_state(p_static), None, length=R
+        )
+
+    kvs = (
+        jnp.asarray(sweep.seed),
+        jnp.asarray(sweep.fanout),
+        jnp.asarray(sweep.max_transmissions),
+        jnp.asarray(sweep.sync_interval),
+        jnp.asarray(sweep.write_rounds),
+    )
+    t0 = time.perf_counter()
+    if has_chaos:
+        planes = {k: jnp.asarray(v) for k, v in sweep.chaos_planes.items()}
+        fn = jax.jit(jax.vmap(lambda kv, ch: lane(kv, ch)))
+        compiled = fn.lower(kvs, planes).compile()
+        t1 = time.perf_counter()
+        out, tel = jax.block_until_ready(compiled(kvs, planes))
+    else:
+        fn = jax.jit(jax.vmap(lambda kv: lane(kv)))
+        compiled = fn.lower(kvs).compile()
+        t1 = time.perf_counter()
+        out, tel = jax.block_until_ready(compiled(kvs))
+    scanned = np.asarray(out[-1])  # device→host fetch inside the timed region
+    t2 = time.perf_counter()
+
+    cp = np.asarray(tel["complete_pairs"])  # [B, R]
+    total = p_static.n_nodes * p_static.n_changes
+    hit = cp == total
+    converged = hit.any(axis=1)
+    first = hit.argmax(axis=1) + 1  # first all-complete round, 1-based
+    rounds = np.where(converged, first, scanned).astype(np.int32)
+
+    telemetry = np.stack(
+        [np.asarray(tel[f]) for f in TELEMETRY_FIELDS], axis=-1
+    ).astype(np.int32)
+
+    stalled: List[Optional[int]] = []
+    curves: List[List[object]] = []
+    bytes_conv = np.zeros(B, dtype=np.int64)
+    for b in range(B):
+        nr = int(rounds[b])
+        row = cp[b, :nr]
+        if converged[b]:
+            stalled.append(None)
+        else:
+            s = 1
+            for i in range(len(row) - 1, 0, -1):
+                if row[i] != row[i - 1]:
+                    s = i + 1
+                    break
+            stalled.append(s)
+        curves.append(
+            flightmod.compress_curve([float(c) / total for c in row])
+        )
+        bytes_conv[b] = profilemod.traffic_bytes(
+            int(telemetry[b, :nr, 0].sum()),  # probe_sends
+            int(telemetry[b, :nr, 1].sum()),  # bcast_sends
+            int(telemetry[b, :nr, 3].sum()),  # sync_sessions
+            int(telemetry[b, :nr, 4].sum()),  # sync_chunks
+        )
+    return FleetResult(
+        p_static=p_static,
+        sweep=sweep,
+        rounds=rounds,
+        converged=converged,
+        stalled_at=stalled,
+        telemetry=telemetry,
+        bytes_to_convergence=bytes_conv,
+        curves=curves,
+        wall_s=t2 - t1,
+        compile_s=t1 - t0,
+        state=tuple(out) if return_state else None,
+        schedule_hashes=sweep.schedule_hashes,
+    )
+
+
+def publish_metrics(res: FleetResult) -> None:
+    """corro.sim.fleet.* gauges (doc/telemetry.md): scenario count,
+    converged count, and the best (minimum) modeled bytes-to-convergence
+    across converged lanes — the headline the tuner optimizes."""
+    from ..utils.metrics import registry
+
+    nodes = str(res.p_static.n_nodes)
+    registry.gauge("corro.sim.fleet.scenarios", nodes=nodes).set(
+        float(res.n_scenarios)
+    )
+    registry.gauge("corro.sim.fleet.converged", nodes=nodes).set(
+        float(res.converged.sum())
+    )
+    conv_bytes = res.bytes_to_convergence[res.converged]
+    if conv_bytes.size:
+        registry.gauge(
+            "corro.sim.fleet.bytes_to_convergence", nodes=nodes
+        ).set(float(conv_bytes.min()))
+
+
+def _lane_doc(res: FleetResult, b: int) -> Dict[str, object]:
+    sw = res.sweep.lane(b)
+    doc: Dict[str, object] = {
+        "lane": b,
+        **sw,
+        "rounds": int(res.rounds[b]),
+        "converged": bool(res.converged[b]),
+        "stalled_at": res.stalled_at[b],
+        "bytes_to_convergence": int(res.bytes_to_convergence[b]),
+        "coverage_rle": res.curves[b],
+    }
+    if res.schedule_hashes is not None:
+        doc["schedule_hash"] = res.schedule_hashes[b]
+    return doc
+
+
+def write_artifact(res: FleetResult, path: str) -> None:
+    """Stamp the fleet into a ``FLEET_r*.json`` artifact: one header with
+    the static split, then one entry per lane with its swept point,
+    outcome, RLE'd coverage curve and chaos provenance hash."""
+    p = res.p_static
+    doc = {
+        "fleet": 1,
+        "n_scenarios": res.n_scenarios,
+        "n_nodes": p.n_nodes,
+        "n_changes": p.n_changes,
+        "nseq_max": p.nseq_max,
+        "topology": p.topology,
+        "max_rounds": p.max_rounds,
+        "packed": p.packed,
+        "framed": p.framed,
+        "static_ceilings": {
+            "fanout": p.fanout,
+            "max_transmissions": p.max_transmissions,
+            "sync_interval": p.sync_interval,
+            "write_rounds": p.write_rounds,
+        },
+        "telemetry_fields": list(TELEMETRY_FIELDS),
+        "wall_s": round(res.wall_s, 6),
+        "compile_s": round(res.compile_s, 6),
+        "converged": int(res.converged.sum()),
+        "scenarios": [_lane_doc(res, b) for b in range(res.n_scenarios)],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+        f.write("\n")
+
+
+# -- BENCHMARKS.md fleet section (generated, never hand-edited) -------------
+
+BEGIN_MARK = (
+    "<!-- fleet:begin (generated by corrosion_tpu.fleet.run; "
+    "do not hand-edit) -->"
+)
+END_MARK = "<!-- fleet:end -->"
+
+
+def fleet_markdown(lines: List[dict]) -> str:
+    """Render the fleet section from bench JSON lines (``bench.py
+    --fleet`` output; lines without ``"fleet": true`` are ignored)."""
+    out = [
+        BEGIN_MARK,
+        "",
+        "## Scenario fleets: one compile, B lanes",
+        "",
+        "A fleet runs B scenarios as ONE ``jax.jit(jax.vmap(...))``",
+        "device program (corrosion_tpu/fleet/); each lane's gossip knobs",
+        "ride the vmap axis as traced operands, so a whole sweep costs",
+        "one XLA compile.  ``solo-sum est`` is one measured cold solo run",
+        "× B (every solo seed is a distinct program, so each would pay",
+        "its own compile); ``speedup`` = solo-sum / fleet wall.",
+        "",
+        "| metric | lanes | converged | compile | execute | rounds "
+        "| solo-sum est | speedup |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for ln in lines:
+        if not ln.get("fleet"):
+            continue
+        rmin, rmax = ln.get("rounds_min"), ln.get("rounds_max")
+        rounds = f"{rmin}–{rmax}" if rmin != rmax else str(rmin)
+        speed = ln.get("solo_sum_est_s", 0) / ln["value"] if ln["value"] else 0
+        out.append(
+            "| {m} | {b} | {c}/{b} | {cs:.2f} s | {es:.2f} s | {r} "
+            "| {ss:.1f} s | **{sp:.1f}×** |".format(
+                m=str(ln.get("metric", "?"))
+                .replace("sim_", "")
+                .replace("_wall", ""),
+                b=ln.get("n_scenarios", "?"),
+                c=ln.get("converged", "?"),
+                cs=ln.get("compile_s", 0.0),
+                es=ln.get("execute_s", 0.0),
+                r=rounds,
+                ss=ln.get("solo_sum_est_s", 0.0),
+                sp=speed,
+            )
+        )
+    out += ["", END_MARK]
+    return "\n".join(out)
+
+
+def update_benchmarks(bench_json_path: str, md_path: str) -> None:
+    """Replace (or append) the marker-delimited fleet section of
+    ``md_path`` from the JSON lines in ``bench_json_path`` — same
+    contract as the roofline (sim/profile.py) and convergence
+    (sim/flight.py) sections."""
+    lines = []
+    with open(bench_json_path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    lines.append(json.loads(raw))
+                except json.JSONDecodeError:
+                    pass
+    section = fleet_markdown(lines)
+    with open(md_path) as f:
+        doc = f.read()
+    if BEGIN_MARK in doc and END_MARK in doc:
+        head, rest = doc.split(BEGIN_MARK, 1)
+        _, tail = rest.split(END_MARK, 1)
+        doc = head + section + tail
+    else:
+        doc = doc.rstrip("\n") + "\n\n" + section + "\n"
+    with open(md_path, "w") as f:
+        f.write(doc)
+
+
+def main() -> None:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        description="regenerate the BENCHMARKS.md fleet section"
+    )
+    ap.add_argument("--bench", default="BENCH_r09.json")
+    ap.add_argument("--md", default="BENCHMARKS.md")
+    args = ap.parse_args()
+    update_benchmarks(args.bench, args.md)
+    print(f"updated {args.md} from {args.bench}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

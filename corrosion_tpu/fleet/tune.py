@@ -1,0 +1,198 @@
+"""Gossip-parameter tuner: successive halving over fleet batches.
+
+Searches the ``fanout × max_transmissions × sync_interval`` frontier for
+the point that converges with minimum modeled network bytes
+(sim/profile.py byte model) under an optional chaos schedule.  Each rung
+evaluates every surviving point over a growing seed set as ONE fleet
+batch (fleet/run.py) — one compile per rung, however many points ride
+it — then keeps the top ``1/eta`` of fully-converging points by mean
+bytes-to-convergence.
+
+Non-converging points are not merely ranked last: a lane that exhausts
+its retransmission budget before reaching every node (BASELINE config 2
+at reduced scale stalls at round 13 with coverage 0.9984,
+sim/flight.py ``stalled_at``) would win any bytes ranking because it
+stops sending.  The tuner flags such points out of the frontier with
+their stall round and recommends only among points whose every seed
+converged — the config-2 acceptance demo in tests/test_sim_fleet.py
+pins this behavior.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..sim.model import SimParams
+from .batch import split
+from .run import FleetResult, run_fleet
+
+__all__ = ["TunePoint", "TuneResult", "tune", "frontier_markdown"]
+
+Point = Tuple[int, int, int]  # (fanout, max_transmissions, sync_interval)
+
+
+@dataclass
+class TunePoint:
+    """One grid point's aggregate over its last-evaluated seed set."""
+
+    fanout: int
+    max_transmissions: int
+    sync_interval: int
+    n_seeds: int
+    n_converged: int
+    mean_bytes: Optional[float]  # over converged seeds; None if none did
+    mean_rounds: Optional[float]
+    stalled_at: List[int] = field(default_factory=list)  # non-conv lanes
+
+    @property
+    def all_converged(self) -> bool:
+        return self.n_converged == self.n_seeds
+
+    def key(self) -> Point:
+        return (self.fanout, self.max_transmissions, self.sync_interval)
+
+
+@dataclass
+class TuneResult:
+    """Frontier table + the recommended operating point."""
+
+    base: SimParams
+    points: List[TunePoint]  # every grid point, last-rung aggregates
+    recommended: Optional[TunePoint]  # min mean_bytes among all-converged
+    flagged: List[TunePoint]  # dropped for a non-converging seed
+    rungs: int
+    compiles: int  # == rungs: one fleet compile per rung
+    fleet_results: List[FleetResult] = field(default_factory=list)
+
+
+def _aggregate(
+    pt: Point, lanes: List[int], res: FleetResult, n_seeds: int
+) -> TunePoint:
+    conv = [b for b in lanes if res.converged[b]]
+    stalls = [res.stalled_at[b] for b in lanes if not res.converged[b]]
+    return TunePoint(
+        fanout=pt[0],
+        max_transmissions=pt[1],
+        sync_interval=pt[2],
+        n_seeds=n_seeds,
+        n_converged=len(conv),
+        mean_bytes=(
+            sum(int(res.bytes_to_convergence[b]) for b in conv) / len(conv)
+            if conv
+            else None
+        ),
+        mean_rounds=(
+            sum(int(res.rounds[b]) for b in conv) / len(conv)
+            if conv
+            else None
+        ),
+        stalled_at=[s for s in stalls if s is not None],
+    )
+
+
+def tune(
+    base: SimParams,
+    fanouts: Sequence[int],
+    max_transmissions: Sequence[int],
+    sync_intervals: Sequence[int],
+    seeds_per_point: int = 2,
+    eta: int = 2,
+    max_rungs: int = 3,
+    chaos=None,
+) -> TuneResult:
+    """Successive-halving search over the knob grid around ``base``.
+
+    ``base`` fixes everything but the three searched knobs (its own
+    fanout/mt/si are ignored); seeds are ``base.seed + k``, and the seed
+    set grows ``eta``-fold per rung while the surviving point set
+    shrinks ``eta``-fold, so every rung costs about the same lane count.
+    ``chaos`` is an optional sim-lowerable ``LoweredChaos`` (horizon ≥
+    ``base.max_rounds``) applied identically to every lane."""
+    grid: List[Point] = [
+        (fo, mt, si)
+        for fo in fanouts
+        for mt in max_transmissions
+        for si in sync_intervals
+    ]
+    assert grid, "tune() over an empty knob grid"
+    survivors = list(grid)
+    latest: Dict[Point, TunePoint] = {}
+    flagged: List[TunePoint] = []
+    fleet_results: List[FleetResult] = []
+    n_seeds = seeds_per_point
+    rung = 0
+    while True:
+        scenarios: List[SimParams] = []
+        lanes_of: Dict[Point, List[int]] = {pt: [] for pt in survivors}
+        for pt in survivors:
+            for k in range(n_seeds):
+                lanes_of[pt].append(len(scenarios))
+                scenarios.append(
+                    base.with_(
+                        fanout=pt[0],
+                        max_transmissions=pt[1],
+                        sync_interval=pt[2],
+                        seed=base.seed + k,
+                    )
+                )
+        chaos_list = None if chaos is None else [chaos] * len(scenarios)
+        p_static, sweep = split(scenarios, chaos=chaos_list)
+        res = run_fleet(p_static, sweep)
+        fleet_results.append(res)
+        rung += 1
+
+        scored: List[TunePoint] = []
+        for pt in survivors:
+            tp = _aggregate(pt, lanes_of[pt], res, n_seeds)
+            latest[pt] = tp
+            if tp.all_converged:
+                scored.append(tp)
+            else:
+                flagged.append(tp)
+        scored.sort(key=lambda tp: tp.mean_bytes)
+        if not scored:
+            survivors = []
+            break
+        keep = max(1, math.ceil(len(scored) / eta))
+        survivors = [tp.key() for tp in scored[:keep]]
+        if len(survivors) <= 1 or rung >= max_rungs:
+            break
+        n_seeds *= eta
+
+    recommended = latest[survivors[0]] if survivors else None
+    return TuneResult(
+        base=base,
+        points=[latest[pt] for pt in grid],
+        recommended=recommended,
+        flagged=flagged,
+        rungs=rung,
+        compiles=rung,
+        fleet_results=fleet_results,
+    )
+
+
+def frontier_markdown(result: TuneResult) -> str:
+    """The frontier table the CLI prints: every grid point with its
+    convergence record and mean bytes, recommendation starred, stalled
+    points labeled with their stall round."""
+    lines = [
+        "| fanout | max_tx | sync_interval | converged | mean rounds "
+        "| mean bytes | note |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    rec_key = result.recommended.key() if result.recommended else None
+    for tp in sorted(result.points, key=lambda t: t.key()):
+        if tp.all_converged:
+            note = "**recommended**" if tp.key() == rec_key else ""
+        else:
+            worst = max(tp.stalled_at) if tp.stalled_at else "?"
+            note = f"non-converging (stalled at round {worst})"
+        mb = f"{tp.mean_bytes:,.0f}" if tp.mean_bytes is not None else "—"
+        mr = f"{tp.mean_rounds:.1f}" if tp.mean_rounds is not None else "—"
+        lines.append(
+            f"| {tp.fanout} | {tp.max_transmissions} | {tp.sync_interval} "
+            f"| {tp.n_converged}/{tp.n_seeds} | {mr} | {mb} | {note} |"
+        )
+    return "\n".join(lines) + "\n"

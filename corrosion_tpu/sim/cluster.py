@@ -61,7 +61,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 from functools import partial
-from typing import List, Optional, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -101,16 +101,125 @@ class SimResult:
     flight: Optional[object] = None  # FlightRecord when run(record=True)
 
 
-def _consts(p: SimParams):
-    """Changeset origins / inject rounds and partition sides (eager)."""
+class Knobs(NamedTuple):
+    """Per-scenario gossip knobs that may ride a fleet vmap axis.
+
+    On the solo path (``make_step(p)``) every field is the Python int
+    from ``SimParams`` and folds into the executable exactly as the old
+    closure constants did.  On the fleet path (corrosion_tpu/fleet) each
+    field is a traced int32 scalar — one lane of the ``SweepParams``
+    vectors — and ``p`` only supplies shape statics and structural
+    ceilings: ``p.fanout`` bounds the unrolled fanout loops (lanes gate
+    slots ``j >= knobs.fanout`` off), ``p.sync_interval > 0`` decides
+    whether the sync machinery exists at all, ``p.max_transmissions``
+    fixes the packed budget lane width, ``p.write_rounds`` is unused
+    (the traced value keys the inject draws directly)."""
+
+    seed: object
+    fanout: object
+    max_transmissions: object
+    sync_interval: object
+    write_rounds: object
+
+
+def knobs_from(p: SimParams) -> Knobs:
+    return Knobs(
+        p.seed, p.fanout, p.max_transmissions, p.sync_interval, p.write_rounds
+    )
+
+
+def _consts(p: SimParams, seed, write_rounds):
+    """Changeset origins / inject rounds and partition sides.  Eager
+    constants on the solo path (Python-int seed); per-lane traced tensors
+    on the fleet path."""
     karange = jnp.arange(p.n_changes, dtype=jnp.int32)
     narange = jnp.arange(p.n_nodes, dtype=jnp.int32)
-    origin = jx_below(p.n_nodes, p.seed, TAG_ORIGIN, karange)
-    inject_round = jx_below(p.write_rounds, p.seed, TAG_INJECT, karange)
+    origin = jx_below(p.n_nodes, seed, TAG_ORIGIN, karange)
+    inject_round = jx_below(write_rounds, seed, TAG_INJECT, karange)
     part = (
-        jx_below(1_000_000, p.seed, TAG_PART, narange) < p.partition_frac_ppm
+        jx_below(1_000_000, seed, TAG_PART, narange) < p.partition_frac_ppm
     ).astype(jnp.int8)
     return origin, inject_round, part
+
+
+@dataclass
+class _StepEnv:
+    """Resolved build-time environment for :func:`make_step`.
+
+    ``build`` is where the host-side branching on the optional inputs
+    lives (knobs defaulting, LoweredChaos vs. stacked plane dict) — it is
+    only ever invoked through the class attribute, so the trace-safety
+    lint's purity closure never treats its body as traced code, and
+    ``make_step`` itself branches only on the plain-bool fields below."""
+
+    fleet: bool
+    kn: Knobs
+    has_chaos: bool
+    has_die: bool
+    part: Optional[jnp.ndarray]
+    c_dead: Optional[jnp.ndarray]
+    c_die: Optional[jnp.ndarray]
+    c_restart: Optional[jnp.ndarray]
+    c_pact: Optional[jnp.ndarray]
+    c_drop: Optional[jnp.ndarray]
+    c_seed: object
+
+    @staticmethod
+    def build(p: SimParams, chaos, chaos_arrays, knobs) -> "_StepEnv":
+        kn = knobs_from(p) if knobs is None else knobs
+        fleet = knobs is not None
+        part = c_dead = c_die = c_restart = c_pact = c_drop = None
+        c_seed = 0
+        has_chaos = has_die = False
+        if chaos is not None:
+            assert chaos_arrays is None, (
+                "pass a LoweredChaos or a stacked plane dict, not both"
+            )
+            chaos.require_sim_lowerable()
+            assert chaos.n_nodes == p.n_nodes, (
+                "chaos schedule sized for another cluster"
+            )
+            assert p.churn_ppm == 0 and p.partition_frac_ppm == 0, (
+                "explicit chaos schedules replace the ad-hoc churn/partition "
+                "scalars; zero them out (schedule.from_sim_params bridges)"
+            )
+            has_chaos = True
+            has_die = chaos.any_die()
+            part = jnp.asarray(chaos.part_side)
+            c_dead = jnp.asarray(chaos.dead)
+            c_die = jnp.asarray(chaos.die)
+            c_restart = jnp.asarray(chaos.restart)
+            c_pact = jnp.asarray(chaos.part_active)
+            if chaos.drop_ppm is not None:
+                c_drop = jnp.asarray(chaos.drop_ppm)
+            c_seed = chaos.schedule.seed
+        elif chaos_arrays is not None:
+            assert p.churn_ppm == 0 and p.partition_frac_ppm == 0, (
+                "chaos plane stacks replace the ad-hoc churn/partition "
+                "scalars; zero them out"
+            )
+            has_chaos = True
+            has_die = "die" in chaos_arrays
+            part = jnp.asarray(chaos_arrays["part_side"]).astype(jnp.int8)
+            c_dead = chaos_arrays["dead"]
+            c_die = chaos_arrays.get("die")
+            c_restart = chaos_arrays["restart"]
+            c_pact = chaos_arrays["part_active"]
+            c_drop = chaos_arrays.get("drop_ppm")
+            c_seed = chaos_arrays["seed"]
+        return _StepEnv(
+            fleet=fleet,
+            kn=kn,
+            has_chaos=has_chaos,
+            has_die=has_die,
+            part=part,
+            c_dead=c_dead,
+            c_die=c_die,
+            c_restart=c_restart,
+            c_pact=c_pact,
+            c_drop=c_drop,
+            c_seed=c_seed,
+        )
 
 
 def init_state(p: SimParams) -> SimState:
@@ -159,7 +268,13 @@ def complete_flags_packed(cov_words: jnp.ndarray, p: SimParams) -> jnp.ndarray:
     return jnp.asarray(pack.valid_lane_mask(p))[None, :] & ~not_complete
 
 
-def make_step(p: SimParams, chaos=None, telemetry: bool = False):
+def make_step(
+    p: SimParams,
+    chaos=None,
+    telemetry: bool = False,
+    knobs=None,
+    chaos_arrays=None,
+):
     """Build the jittable one-round transition for params ``p``.
 
     With ``telemetry=True`` the returned step yields
@@ -185,34 +300,57 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
     injector consults, so both executors drop the same links.  SWIM
     probes are exempt from link drops: probe targets are not paired
     across backends, and a single dropped probe would fork the
-    membership trajectories (doc/chaos.md)."""
+    membership trajectories (doc/chaos.md).
+
+    ``knobs`` is an optional :class:`Knobs` of (possibly traced) sweep
+    values — the fleet path (corrosion_tpu/fleet).  When given, the
+    gossip knobs stop being Python closure constants: the counter-RNG
+    seed, fanout, retransmission budget, sync cadence and write window
+    become scalar operands of the compiled step, so ``jax.vmap`` can
+    batch B scenarios over one executable.  ``p`` then supplies shape
+    statics only, with ``p.fanout`` / ``p.sync_interval`` acting as
+    structural ceilings (see :class:`Knobs`).  Lanes whose fanout is
+    below the ceiling gate the surplus slots off; the surviving slots
+    key their draws exactly like a solo run with that fanout, so every
+    lane stays bit-identical to ``run()`` with its own SimParams
+    (tests/test_sim_fleet.py).
+
+    ``chaos_arrays`` is the fleet twin of ``chaos``: an already-stacked
+    plane dict from :meth:`corrosion_tpu.chaos.LoweredChaos.stack`,
+    sliced (or vmapped) to one lane — same per-round gathers, without a
+    host ``LoweredChaos`` object per trace."""
     N, K, S = p.n_nodes, p.n_changes, max(1, p.nseq_max)
-    T8 = jnp.int8(p.max_transmissions)
     D = p.churn_down_rounds
-    origin, inject_round, part = _consts(p)
-    # graftlint: disable=GL101 (static build-time branch: chaos is a host dataclass bound via partial, never a tracer)
-    if chaos is not None:
-        chaos.require_sim_lowerable()
-        assert chaos.n_nodes == N, "chaos schedule sized for another cluster"
-        assert p.churn_ppm == 0 and p.partition_frac_ppm == 0, (
-            "explicit chaos schedules replace the ad-hoc churn/partition "
-            "scalars; zero them out (schedule.from_sim_params bridges)"
-        )
-        part = jnp.asarray(chaos.part_side)
-        c_dead = jnp.asarray(chaos.dead)
-        c_die = jnp.asarray(chaos.die)
-        c_restart = jnp.asarray(chaos.restart)
-        c_pact = jnp.asarray(chaos.part_active)
-        c_drop = (
-            jnp.asarray(chaos.drop_ppm) if chaos.drop_ppm is not None else None
-        )
-        c_seed = chaos.schedule.seed
-    else:
-        c_drop = None
+    env = _StepEnv.build(p, chaos, chaos_arrays, knobs)
+    kn = env.kn
+    fleet = env.fleet
+    has_chaos = env.has_chaos
+    has_die = env.has_die
+    c_dead = env.c_dead
+    c_die = env.c_die
+    c_restart = env.c_restart
+    c_pact = env.c_pact
+    c_drop = env.c_drop
+    c_seed = env.c_seed
+    seed = kn.seed
+    origin, inject_round, part = _consts(p, seed, kn.write_rounds)
+    if has_chaos:
+        part = env.part
     narange = jnp.arange(N, dtype=jnp.int32)
     karange = jnp.arange(K, dtype=jnp.int32)
-    full = jnp.asarray(syncmod.full_masks(p))
-    aidx, vidx, n_actors = syncmod.actor_index(p)
+    if fleet:
+        # seed-dependent "constants" become traced per-lane tensors; the
+        # above-head sync rule walks the traced next-version map instead
+        # of host actor_index/heads (sim/sync.py)
+        full = syncmod.jx_full_masks(p, seed)
+        nxt_t, steps_t = syncmod.jx_next_version_index(origin)
+        T8 = jnp.asarray(kn.max_transmissions).astype(jnp.int8)
+        fo32 = jnp.asarray(kn.fanout).astype(jnp.int32)
+        si32 = jnp.asarray(kn.sync_interval).astype(jnp.int32)
+    else:
+        full = jnp.asarray(syncmod.full_masks(p))
+        aidx, vidx, n_actors = syncmod.actor_index(p)
+        T8 = jnp.int8(p.max_transmissions)
     attempts = p.swim_probe_attempts if p.swim else 1
     if p.packed:
         # packed-layout constants (eager, folded into the executable):
@@ -220,7 +358,12 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
         # maps for the inject scatters — per changeset (cov layout) and
         # per (changeset, chunk) (budget layout)
         cb, bb = pack.lane_bits(p), pack.budget_lane_bits(p)
-        full_w = jnp.asarray(pack.full_masks_packed(p))
+        if fleet:
+            full_w = pack.pack_cov(full, p)
+            T32 = jnp.asarray(kn.max_transmissions).astype(jnp.uint32)
+        else:
+            full_w = jnp.asarray(pack.full_masks_packed(p))
+            T32 = jnp.uint32(p.max_transmissions)
         full32 = full.astype(jnp.uint32)
         kword = karange // pack.lanes_per_word(p)
         kshift = (karange % pack.lanes_per_word(p)).astype(jnp.uint32) * jnp.uint32(cb)
@@ -229,7 +372,6 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
         ks_word = ks // lanes_b
         ks_shift = (ks % lanes_b).astype(jnp.uint32) * jnp.uint32(bb)
         ks_k = ks // S
-        T32 = jnp.uint32(p.max_transmissions)
         valid_w = jnp.asarray(pack.valid_lane_mask(p))
     if p.framed:
         # framed-layout constants: the broadcast frame lives in cov WORD
@@ -242,9 +384,25 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
             jnp.uint32
         ) * jnp.uint32(f_cb)
 
+    if fleet:
+
+        def slot_on(j: int, x):
+            """Gate fanout slot ``j`` on lanes whose swept fanout covers
+            it.  Slots ``j >= knobs.fanout`` still make their draws (the
+            counter RNG is stateless, so discarded draws cannot shift any
+            other stream) but deliver nothing and count nothing — the
+            surviving slots are keyed exactly like a solo run with that
+            fanout."""
+            return jnp.logical_and(x, fo32 > j)
+
+    else:
+
+        def slot_on(j: int, x):
+            return x
+
     def death(x):
         """bool[N]: churn death draw hit at round x (x may be negative)."""
-        hit = jx_below(1_000_000, p.seed, TAG_CHURN, x, narange) < p.churn_ppm
+        hit = jx_below(1_000_000, seed, TAG_CHURN, x, narange) < p.churn_ppm
         in_window = jnp.logical_and(x >= 0, x < p.churn_rounds)
         return jnp.logical_and(hit, in_window)
 
@@ -294,13 +452,13 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
         suffix = () if a == 0 else (a,)
         if p.topology == ER:
             i = jx_below(
-                p.er_degree, p.seed, TAG_BCAST, r, nvec, slot, kvec, *suffix
+                p.er_degree, seed, TAG_BCAST, r, nvec, slot, kvec, *suffix
             )
-            t = jx_below(N - 1, p.seed, TAG_TOPO, nvec, i)
+            t = jx_below(N - 1, seed, TAG_TOPO, nvec, i)
         elif p.topology == POWERLAW:
             draws = [
                 jx_below(
-                    N - 1, p.seed, TAG_BCAST, r, nvec,
+                    N - 1, seed, TAG_BCAST, r, nvec,
                     slot * p.powerlaw_gamma + g, kvec, *suffix,
                 )
                 for g in range(p.powerlaw_gamma)
@@ -311,7 +469,7 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
         else:
             assert p.topology == COMPLETE
             u = jx_below(
-                N - 1 - len(chosen), p.seed, TAG_BCAST, r, nvec, slot,
+                N - 1 - len(chosen), seed, TAG_BCAST, r, nvec, slot,
                 kvec, *suffix,
             )
             u = jnp.broadcast_to(u, (N, K)).astype(jnp.int32)
@@ -336,13 +494,13 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
         suffix = () if a == 0 else (a,)
         if p.topology == ER:
             i = jx_below(
-                p.er_degree, p.seed, TAG_BCAST, r, narange, slot, *suffix
+                p.er_degree, seed, TAG_BCAST, r, narange, slot, *suffix
             )
-            t = jx_below(N - 1, p.seed, TAG_TOPO, narange, i)
+            t = jx_below(N - 1, seed, TAG_TOPO, narange, i)
         elif p.topology == POWERLAW:
             draws = [
                 jx_below(
-                    N - 1, p.seed, TAG_BCAST, r, narange,
+                    N - 1, seed, TAG_BCAST, r, narange,
                     slot * p.powerlaw_gamma + g, *suffix,
                 )
                 for g in range(p.powerlaw_gamma)
@@ -352,14 +510,14 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                 t = jnp.minimum(t, d)
         else:
             assert p.topology == COMPLETE
-            t = jx_below(N - 1, p.seed, TAG_BCAST, r, narange, slot, *suffix)
+            t = jx_below(N - 1, seed, TAG_BCAST, r, narange, slot, *suffix)
         return t + (t >= narange)  # skip self
 
     per_node = p.swim and p.swim_per_node_views
 
     def step(state: SimState) -> SimState:
         cov, budget, status, since, r = state
-        if chaos is not None:
+        if has_chaos:
             # liveness / restart / partition gathers into the lowered
             # schedule tensors (constants folded into the executable)
             alive = jnp.logical_not(c_dead[r])
@@ -421,7 +579,7 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
 
             def probe_draw(a: int):
                 suffix = () if a == 0 else (a,)
-                t = jx_below(N - 1, p.seed, TAG_PROBE, r, narange, *suffix)
+                t = jx_below(N - 1, seed, TAG_PROBE, r, narange, *suffix)
                 return t + (t >= narange)
 
         if per_node:
@@ -648,6 +806,7 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                                 ),
                                 alive[t],
                             )
+                            ok = slot_on(j, ok)
                             if c_drop is not None:
                                 # lowered drop planes filter the FRAME:
                                 # the row value is zeroed before it
@@ -656,7 +815,7 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                                 ok = jnp.logical_and(ok, link_up(nvec, t))
                             if telemetry:
                                 tel = tel + jnp.logical_and(
-                                    val_nk != 0, found
+                                    val_nk != 0, slot_on(j, found)
                                 ).sum(dtype=jnp.int32)
                             keys_l.append(
                                 (
@@ -684,6 +843,7 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                                 jnp.logical_and(found, pvec == pvec[t]),
                                 alive[t],
                             )
+                            ok = slot_on(j, ok)
                             if c_drop is not None:
                                 ok = jnp.logical_and(
                                     ok, link_up(narange, t)
@@ -691,7 +851,7 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                             if telemetry:
                                 tel = tel + pack.popcount32(
                                     jnp.where(
-                                        found[:, None],
+                                        slot_on(j, found)[:, None],
                                         hold_s,
                                         jnp.uint32(0),
                                     )
@@ -764,11 +924,12 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                             jnp.logical_and(found, pvec[:, None] == pvec[t]),
                             alive[t],
                         )
+                        ok = slot_on(j, ok)
                         if c_drop is not None:
                             ok = jnp.logical_and(ok, link_up(nvec, t))
                         if telemetry:
                             tel_bcast = tel_bcast + jnp.logical_and(
-                                hold, found
+                                hold, slot_on(j, found)
                             ).sum(dtype=jnp.int32)
                         plane = plane.at[t, kk].max(hold & ok)
                         chosen.append(t)
@@ -785,11 +946,12 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                         ok = jnp.logical_and(
                             jnp.logical_and(found, pvec == pvec[t]), alive[t]
                         )
+                        ok = slot_on(j, ok)
                         if c_drop is not None:
                             ok = jnp.logical_and(ok, link_up(narange, t))
                         if telemetry:
                             tel_bcast = tel_bcast + jnp.logical_and(
-                                hold, found[:, None]
+                                hold, slot_on(j, found)[:, None]
                             ).sum(dtype=jnp.int32)
                         plane = plane.at[t].max(hold & ok[:, None])
                 delivered = delivered | jnp.where(plane, bit, jnp.uint8(0))
@@ -839,7 +1001,7 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
 
             def sync_draw(a: int):
                 suffix = () if a == 0 else (a,)
-                q = jx_below(N - 1, p.seed, TAG_SYNC, r, narange, *suffix)
+                q = jx_below(N - 1, seed, TAG_SYNC, r, narange, *suffix)
                 return q + (q >= narange)
 
             q, found = draw_excluding(down2, view, sync_draw)
@@ -864,7 +1026,16 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                     # above-head case is a pointer-jumped suffix-OR over
                     # uint8 seen flags inside jx_available_packed — no
                     # per-(node, actor) heads tensor, no [N, K] int32
-                    avail = syncmod.jx_available_packed(c, c[q], full_w, p)
+                    if fleet:
+                        # traced next-version map (the host map needs the
+                        # concrete seed)
+                        avail = syncmod.jx_available_packed(
+                            c, c[q], full_w, p, nxt=nxt_t, steps=steps_t
+                        )
+                    else:
+                        avail = syncmod.jx_available_packed(
+                            c, c[q], full_w, p
+                        )
                     if p.sync_chunk_budget > 0:
                         # the (version, seq)-ordered cumsum cap wants
                         # per-changeset masks; transient unpack/repack
@@ -878,10 +1049,17 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                     else:
                         pulled = avail
                 else:
-                    heads_mine = syncmod.jx_heads(c, aidx, vidx, n_actors)
-                    avail = syncmod.jx_available(
-                        c, c[q], full, heads_mine, aidx, vidx
-                    )
+                    if fleet:
+                        avail = syncmod.jx_available_nextmap(
+                            c, c[q], full, nxt_t, steps_t
+                        )
+                    else:
+                        heads_mine = syncmod.jx_heads(
+                            c, aidx, vidx, n_actors
+                        )
+                        avail = syncmod.jx_available(
+                            c, c[q], full, heads_mine, aidx, vidx
+                        )
                     pulled = syncmod.jx_budget_transfer(
                         avail, p.sync_chunk_budget
                     )
@@ -890,7 +1068,15 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
                 # sort-free masked OR — sim/frames.py owns the algebra
                 return framesmod.identity_frame_apply(c, okq, pulled)
 
-            due = (r + 1) % p.sync_interval == 0
+            if fleet:
+                # lanes may sweep sync_interval down to 0 (sync off);
+                # the modulus is clamped so XLA never divides by zero on
+                # the dead branch of the select
+                due = jnp.logical_and(
+                    si32 > 0, (r + 1) % jnp.maximum(si32, 1) == 0
+                )
+            else:
+                due = (r + 1) % p.sync_interval == 0
             if telemetry:
                 # widen the cond's carry with (sessions, chunks pulled) so
                 # the stats ride OUT of the gated branch; the off-round
@@ -917,10 +1103,9 @@ def make_step(p: SimParams, chaos=None, telemetry: bool = False):
         # Hash-selected under the ad-hoc scalars, schedule-driven under
         # an explicit chaos schedule
         die = None
-        if chaos is not None:
-            if chaos.any_die():
-                die = c_die[r]
-        elif p.churn_ppm > 0 and p.churn_rounds > 0:
+        if has_die:
+            die = c_die[r]
+        elif (not has_chaos) and p.churn_ppm > 0 and p.churn_rounds > 0:
             die = death(r)
         # graftlint: disable=GL101 (identity check on whether a wipe plane exists this trace — decided at trace time, not a tracer comparison)
         if die is not None:
@@ -1014,6 +1199,17 @@ def _full_plane(p: SimParams) -> jnp.ndarray:
     if p.packed:
         return jnp.asarray(pack.full_masks_packed(p))
     return jnp.asarray(syncmod.full_masks(p))
+
+
+def full_plane_for(p: SimParams, seed) -> jnp.ndarray:
+    """Traced twin of :func:`_full_plane`: the done-predicate plane from a
+    (possibly traced) per-lane seed — the fleet runner's convergence test
+    (fleet/run.py) compares each lane's cov plane against its OWN full
+    plane inside the vmapped scan body."""
+    full = syncmod.jx_full_masks(p, seed)
+    if p.packed:
+        return pack.pack_cov(full, p)
+    return full
 
 
 def _run_loop(p: SimParams, state: SimState, chaos=None) -> SimState:

@@ -190,6 +190,22 @@ class SimParams:
     framed: bool = False
     seed: int = 0
 
+    def __post_init__(self) -> None:
+        # Surface packed-layout limits at construction time instead of as
+        # an opaque assert deep inside pack.budget_lane_bits.  replace()
+        # (and therefore with_()) re-invokes __init__, so every derived
+        # params object is re-validated.
+        if self.packed and self.max_transmissions > 15:
+            raise ValueError(
+                "max_transmissions must be <= 15 when packed=True "
+                f"(4-bit budget lanes); got max_transmissions="
+                f"{self.max_transmissions}"
+            )
+        if self.max_transmissions < 0:
+            raise ValueError(
+                f"max_transmissions must be >= 0; got {self.max_transmissions}"
+            )
+
     def with_(self, **kw) -> "SimParams":
         return replace(self, **kw)
 

@@ -268,6 +268,40 @@ def bench_fields(prof: RoundProfile) -> Dict[str, object]:
     return out
 
 
+# -- network-traffic byte model (the fleet tuner's cost function) -----------
+
+# Modeled on the reference runtime's wire shapes: sync sessions stream
+# 8 KiB chunk payloads with server-side pacing (api/peer.rs:611-667 —
+# the same constant sync.py's budget models); broadcast payloads carry
+# one chunk plus change-envelope framing; SWIM probes are a small
+# ping/ack pair.  The absolute constants matter less than being FIXED:
+# the tuner (fleet/tune.py) ranks (fanout, max_transmissions,
+# sync_interval) points by this model, and any monotone per-message cost
+# preserves the ranking.
+CHUNK_PAYLOAD_BYTES = 8192
+BCAST_OVERHEAD_BYTES = 64
+PROBE_BYTES = 40
+SYNC_SESSION_BYTES = 256
+
+
+def traffic_bytes(
+    probe_sends: int,
+    bcast_sends: int,
+    sync_sessions: int,
+    sync_chunks: int,
+) -> int:
+    """Modeled network bytes for cumulative telemetry counters (the
+    corro.sim.fleet.bytes_to_convergence gauge, doc/telemetry.md):
+    probes, broadcast payload sends (each one chunk + envelope), sync
+    session handshakes (needs exchange) and sync chunk transfers."""
+    return int(
+        probe_sends * PROBE_BYTES
+        + bcast_sends * (BCAST_OVERHEAD_BYTES + CHUNK_PAYLOAD_BYTES)
+        + sync_sessions * SYNC_SESSION_BYTES
+        + sync_chunks * CHUNK_PAYLOAD_BYTES
+    )
+
+
 # -- BENCHMARKS.md roofline section (generated, never hand-edited) ----------
 
 BEGIN_MARK = "<!-- roofline:begin (generated by corrosion_tpu.sim.profile; do not hand-edit) -->"

@@ -105,14 +105,22 @@ def _u32(f):
     return jnp.asarray(f).astype(jnp.uint32)
 
 
-def jx_hash(seed: int, *fields):
+def jx_hash(seed, *fields):
     """Chained mix over (seed, *fields); fields may be scalars or arrays
-    (broadcast together).  Bit-identical to :func:`py_hash`."""
-    h = jx_mix(jnp.uint32(seed & _M) ^ jnp.uint32(0x85EBCA6B))
+    (broadcast together).  Bit-identical to :func:`py_hash`.
+
+    ``seed`` may be a Python int (solo path — folds to a constant at trace
+    time) or a traced uint32/int32 scalar (fleet path — the per-lane
+    scenario seed rides the vmap axis, sim/fleet/).  Both route through
+    :func:`_u32`, so the mixed bits are identical either way.
+    """
+    h = jx_mix(_u32(seed) ^ jnp.uint32(0x85EBCA6B))
     for f in fields:
         h = jx_mix(h + _u32(f) * jnp.uint32(_GOLD))
     return h
 
 
-def jx_below(n: Union[int, "jnp.ndarray"], seed: int, *fields):
-    return (jx_hash(seed, *fields) % jnp.uint32(n)).astype(jnp.int32)
+def jx_below(n: Union[int, "jnp.ndarray"], seed, *fields):
+    """``jx_hash(...) mod n``; ``n`` may also be traced (fleet write-round
+    sweeps), as long as it is nonzero on every lane."""
+    return (jx_hash(seed, *fields) % _u32(n)).astype(jnp.int32)

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .model import SimParams
-from .rng import TAG_NSEQ, TAG_ORIGIN, py_below
+from .rng import TAG_NSEQ, TAG_ORIGIN, jx_below, py_below
 
 # -- chunk-shape constants (static per SimParams) ---------------------------
 
@@ -70,6 +70,25 @@ def py_nseq_draw(p: SimParams, k: int) -> int:
 def full_masks(p: SimParams) -> np.ndarray:
     """[K] uint8: the all-chunks coverage mask per changeset."""
     return ((1 << nseq_array(p)) - 1).astype(np.uint8)
+
+
+def jx_nseq_array(p: SimParams, seed) -> jnp.ndarray:
+    """Traced twin of :func:`nseq_array`: [K] int32 chunk counts from a
+    (possibly traced) seed.  Fleet lanes sweep the seed along a vmap axis,
+    so the K-sized "constants" become per-lane tensors; for a Python-int
+    seed this is bit-identical to the host version (same counter draws)."""
+    assert 1 <= p.nseq_max <= 8, "coverage masks are uint8"
+    if p.nseq_max <= 1:
+        return jnp.ones(p.n_changes, dtype=jnp.int32)
+    kr = jnp.arange(p.n_changes, dtype=jnp.int32)
+    return 1 + jx_below(p.nseq_max, seed, TAG_NSEQ, kr)
+
+
+def jx_full_masks(p: SimParams, seed) -> jnp.ndarray:
+    """Traced twin of :func:`full_masks`: [K] uint8 all-chunks masks."""
+    return ((jnp.uint32(1) << jx_nseq_array(p, seed).astype(jnp.uint32)) - 1).astype(
+        jnp.uint8
+    )
 
 
 def actor_index(p: SimParams) -> Tuple[np.ndarray, np.ndarray, int]:
@@ -158,6 +177,36 @@ def next_version_index(p: SimParams) -> Tuple[np.ndarray, int]:
     return nxt, steps
 
 
+def jx_next_version_index(origin: jnp.ndarray) -> Tuple[jnp.ndarray, int]:
+    """Traced twin of :func:`next_version_index`, built from a (possibly
+    traced) [K] origin-node vector instead of host hash draws.
+
+    ``nxt[k]`` = smallest same-origin index > k (self-loop at each
+    actor's last version).  The step count must be static under jit, so
+    it is the worst case ``ceil(log2(K))`` — extra doubling passes are
+    idempotent (the jump map and suffix-OR both reach their fixpoints),
+    so results match the host map's exact-step walk bit for bit."""
+    K = origin.shape[0]
+    kr = jnp.arange(K, dtype=jnp.int32)
+    later_same = (origin[None, :] == origin[:, None]) & (kr[None, :] > kr[:, None])
+    cand = jnp.where(later_same, kr[None, :], jnp.int32(K))
+    nxt = jnp.min(cand, axis=1)
+    nxt = jnp.where(nxt == K, kr, nxt).astype(jnp.int32)
+    steps = int(np.ceil(np.log2(K))) if K > 1 else 0
+    return nxt, steps
+
+
+def _suffix_or_seen(seen8: jnp.ndarray, nxt, steps: int) -> jnp.ndarray:
+    """OR of ``seen8[:, k']`` over same-actor k' >= k (incl. self), by
+    pointer-jumping the next-version map ``steps`` times."""
+    sfx = seen8
+    jump = nxt
+    for _ in range(steps):
+        sfx = sfx | jnp.take(sfx, jnp.asarray(jump), axis=1)
+        jump = jnp.take(jnp.asarray(jump), jnp.asarray(jump))
+    return sfx
+
+
 def jx_heads(cov: jnp.ndarray, aidx, vidx, n_actors: int) -> jnp.ndarray:
     """[N, A] int32: per (node, actor) head = highest version with any
     coverage (buffered partials count as seen, matching BookedVersions —
@@ -208,6 +257,32 @@ def jx_available(
     return servable.astype(jnp.uint8)
 
 
+def jx_available_nextmap(
+    cov_mine: jnp.ndarray,  # [N, K] uint8 (receiver rows)
+    cov_theirs: jnp.ndarray,  # [N, K] uint8 (peer rows, aligned)
+    full: jnp.ndarray,  # [K] uint8 (possibly traced, jx_full_masks)
+    nxt,  # [K] next-version map (jx_next_version_index)
+    steps: int,
+) -> jnp.ndarray:
+    """Traced-constant twin of :func:`jx_available`: the same three-case
+    rule, but "above head" computed as a suffix-OR walk of the
+    next-version map instead of the ``jx_heads`` segment-max (whose
+    ``aidx``/``vidx`` inputs are host constants of the seed — unavailable
+    when the seed rides a fleet vmap axis).  Within one actor ``vidx``
+    ascends with changeset id, so ``vidx[k] > head`` ⇔ no same-actor
+    k' >= k has any coverage — exactly the suffix-OR of the seen flags.
+    Bit-identical to :func:`jx_available` for concrete inputs."""
+    miss = cov_theirs & ~cov_mine
+    seen8 = (cov_mine > 0).astype(jnp.uint8)
+    above_head = _suffix_or_seen(seen8, nxt, steps) == 0
+    theirs_complete = cov_theirs == full[None, :]
+    gap = cov_mine == 0
+    servable = jnp.where(
+        above_head | ~gap, miss, jnp.where(theirs_complete, miss, 0)
+    )
+    return servable.astype(jnp.uint8)
+
+
 def py_available(
     cov_mine: Sequence[int],
     cov_theirs: Sequence[int],
@@ -238,6 +313,8 @@ def jx_available_packed(
     theirs_w: jnp.ndarray,  # [N, Wc] uint32 (peer rows, aligned)
     full_w: jnp.ndarray,  # [Wc] uint32 packed full masks
     p: SimParams,
+    nxt=None,  # optional traced next-version map override (fleet)
+    steps: int = None,
 ) -> jnp.ndarray:
     """[N, Wc] uint32: packed twin of :func:`jx_available` — the same
     three-case serving rule as carry-free word algebra, one word = up to
@@ -280,16 +357,13 @@ def jx_available_packed(
     seen8 = ((has_any[:, kw] >> ksh[None, :]) & jnp.uint32(1)).astype(
         jnp.uint8
     )
-    nxt, steps = next_version_index(p)
-    sfx = seen8  # OR over seen[k'] for same-actor k' >= k (incl. self)
-    jump = nxt
-    for _ in range(steps):
-        sfx = sfx | jnp.take(sfx, jnp.asarray(jump), axis=1)
-        jump = jump[jump]
+    if nxt is None:
+        nxt, steps = next_version_index(p)
+    # OR over seen[k'] for same-actor k' >= k (incl. self);
     # vidx[k] > head  ⇔  no same-actor version >= vidx[k] is seen; the
     # self term makes this false whenever seen[k] — which has_any then
     # serves, exactly the dense rule's case split
-    above_head = sfx == 0
+    above_head = _suffix_or_seen(seen8, nxt, steps) == 0
     serve = pack.pack_flags(above_head, p) | has_any | (lsb & ~not_complete)
     return miss & pack.lane_fill(serve, bits)
 

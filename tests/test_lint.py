@@ -440,6 +440,51 @@ def test_cli_lint_chaos_package_clean_at_warning():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+def test_cli_lint_fleet_package_clean_at_warning():
+    """ISSUE satellite: the fleet package (vmapped sweeps + tuner) holds
+    the warning bar — no new suppressions rode in with the subsystem."""
+    proc = cli_lint(["--fail-on=warning", "corrosion_tpu/fleet"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- fleet vmap over a done-gated scan: trace-safety fixtures -----------------
+
+def test_gl101_python_branch_on_done_under_vmap():
+    # the bug the fleet lane must avoid: a Python `if` on the per-lane
+    # convergence predicate — a tracer inside jit(vmap(...)), and under
+    # vmap there isn't even a concrete value to branch on
+    bad = """
+import jax
+from jax import lax
+def lane(state, full):
+    def body(s, _):
+        done = (s[0] == full).all()
+        if done:
+            return s, 0
+        return (s[0] + 1,), 1
+    return lax.scan(body, state, None, length=8)
+out = jax.jit(jax.vmap(lane))
+"""
+    assert "GL101" in trace_rules(bad)
+
+
+def test_gl101_done_gated_scan_under_vmap_not_flagged():
+    # the fleet/run.py idiom: the SAME predicate routed through lax.cond
+    # inside the scan body, vmapped and jitted — lowers to select, every
+    # lane keeps its own frozen carry; must lint clean
+    good = """
+import jax
+from jax import lax
+def lane(state, full):
+    def body(s, _):
+        done = (s[0] == full).all()
+        return lax.cond(done, lambda x: (x, 0), lambda x: ((x[0] + 1,), 1), s)
+    return lax.scan(body, state, None, length=8)
+out = jax.jit(jax.vmap(lane))
+"""
+    assert "GL101" not in trace_rules(good)
+
+
 # -- chaos lowering into lax.scan: trace-safety fixtures ----------------------
 
 def test_gl101_python_branch_on_traced_chaos_mask():

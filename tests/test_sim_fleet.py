@@ -1,0 +1,381 @@
+"""Scenario fleets (corrosion_tpu/fleet/) — the solo path stays the oracle.
+
+A fleet runs B scenarios as one ``jax.jit(jax.vmap(...))`` program with
+the gossip knobs as traced operands (ISSUE 6).  That is a *recompilation
+of the sweep*, not of the round model, so the evidence required is
+bit-identity:
+
+1. fleet lane == solo ``cluster.run()`` — exact rounds, converged flag
+   and final state — on all five BASELINE configs, unpacked and
+   packed+framed (the production layout);
+2. a >= 20-draw property matrix over random statics × random sweep
+   points × {packed, framed} × chaos drop/dup, each lane against its
+   solo oracle (chaos lanes against ``cluster.run(p, chaos=...)``);
+3. lane independence: mutating one lane's seed leaves every other
+   lane's rounds, state and telemetry byte-identical;
+4. the ``batch.split`` static/traced contract (mismatched shape statics
+   rejected BY NAME) and ``LoweredChaos.stack`` shape/horizon guards;
+5. ``SimParams`` packed-budget validation: ``packed=True`` caps
+   ``max_transmissions`` at 15 (4-bit budget lanes) and ``with_()``
+   re-validates — the error must name the field;
+6. the tuner acceptance demo: pointed at config 2's regime it flags the
+   ``max_transmissions=6, sync_interval=0`` corner as non-converging
+   (reproducing PR 5's stalled_at=13 strand) and recommends a
+   converging neighbor.
+
+One layout caveat (fleet/batch.py): a packed fleet whose static
+``max_transmissions`` ceiling crosses pack.py's 2-bit/4-bit budget lane
+boundary stores identical budget VALUES in different word layouts than
+the lanes' solo runs, so budget words compare canonicalized
+(``pack.unpack_budget``); everything else compares raw.
+"""
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.chaos import GenParams, generate, lower
+from corrosion_tpu.chaos.lower import LoweredChaos
+from corrosion_tpu.fleet import batch
+from corrosion_tpu.fleet import run as fleetrun
+from corrosion_tpu.fleet.tune import tune
+from corrosion_tpu.sim import cluster, model, pack
+from corrosion_tpu.sim.model import TELEMETRY_FIELDS
+
+# -- the BASELINE configs at test scale (mirrors tests/test_sim_frames.py) --
+
+
+def small_configs():
+    return {
+        "config1_ring3": model.config1_ring3(seed=7),
+        "config2_er": model.config2_er1k(seed=7).with_(
+            n_nodes=96, n_changes=16, max_rounds=128
+        ),
+        "config3_powerlaw": model.config3_powerlaw10k(seed=7).with_(
+            n_nodes=96, n_changes=16, write_rounds=4, max_rounds=192
+        ),
+        "config4_churn": model.config4_churn100k(seed=7).with_(
+            n_nodes=96, n_changes=16, write_rounds=4,
+            churn_rounds=6, max_rounds=192,
+        ),
+        "config5_partition": model.config5_partition100k(seed=7).with_(
+            n_nodes=96, n_changes=16, write_rounds=4,
+            partition_rounds=10, max_rounds=192,
+        ),
+    }
+
+
+def _budget_canon(words, p):
+    """Budget plane in a layout-free form (see module docstring)."""
+    if p.packed:
+        return np.asarray(pack.unpack_budget(words, p))
+    return np.asarray(words)
+
+
+def fleet_vs_solo(scenarios, chaos=None):
+    """Run the solo oracle for every lane, then the fleet, and assert
+    exact rounds/converged/final-state equality lane by lane.
+
+    The solo runs go FIRST so the fleet scan can be bounded just past
+    the slowest lane's convergence round: under vmap the done-gate is a
+    ``select``, so every lane pays every scanned round — scanning to
+    ``max_rounds`` would multiply test wall-clock for nothing.  The
+    bound changes no observable: the done-gate freezes each lane's
+    carry at its own convergence round, and any non-converged solo lane
+    pins the horizon back to ``max_rounds``."""
+    p_static, sweep = batch.split(scenarios, chaos=chaos)
+    solos = [
+        cluster.run(
+            batch.lane_params(p_static, sweep, i),
+            chaos=chaos[i] if chaos else None,
+            return_state=True,
+        )
+        for i in range(sweep.n_scenarios)
+    ]
+    horizon = max(s.rounds for s in solos) + 4
+    if not all(s.converged for s in solos):
+        horizon = p_static.max_rounds
+    horizon = min(horizon, p_static.max_rounds)
+    res = fleetrun.run_fleet(
+        p_static, sweep, return_state=True, n_rounds=horizon
+    )
+    for i, solo in enumerate(solos):
+        p_lane = batch.lane_params(p_static, sweep, i)
+        assert solo.rounds == int(res.rounds[i]), (
+            f"lane {i}: solo rounds {solo.rounds} != fleet "
+            f"{int(res.rounds[i])} ({sweep.lane(i)})"
+        )
+        assert solo.converged == bool(res.converged[i]), sweep.lane(i)
+        fleet_state = tuple(np.asarray(x)[i] for x in res.state)
+        solo_state = tuple(np.asarray(x) for x in solo.state)
+        assert len(fleet_state) == len(solo_state)
+        # element 1 is the retransmission-budget plane; canonicalize it
+        assert (
+            _budget_canon(fleet_state[1], p_static)
+            == _budget_canon(solo_state[1], p_lane)
+        ).all(), f"lane {i}: budget mismatch"
+        for j, (xf, xs) in enumerate(zip(fleet_state, solo_state)):
+            if j == 1:
+                continue
+            assert xf.dtype == xs.dtype, (i, j)
+            assert (xf == xs).all(), f"lane {i}: state element {j} mismatch"
+    return res
+
+
+# -- 1. five BASELINE configs: every fleet lane == solo ---------------------
+
+
+@pytest.mark.parametrize("layout", ["unpacked", "packed_framed"])
+@pytest.mark.parametrize("name", list(small_configs()))
+def test_fleet_matches_solo_baseline(name, layout):
+    p = small_configs()[name]
+    if layout == "packed_framed":
+        p = p.with_(packed=True, framed=True)
+    # two lanes: the config itself plus a seed variant — enough to prove
+    # the vmap axis doesn't couple lanes while keeping compile cost sane
+    fleet_vs_solo([p, p.with_(seed=13)])
+
+
+def test_fleet_knob_sweep_under_wider_static_ceiling():
+    """Lanes whose fanout/max_tx/sync_interval sit BELOW the fleet's
+    structural ceilings (surplus draw slots gated off, sync machinery
+    compiled in but idle for sync-off lanes) — packed, so this also
+    crosses the 2-bit/4-bit budget lane boundary."""
+    base = small_configs()["config2_er"].with_(packed=True)
+    scenarios = [
+        base.with_(fanout=2, max_transmissions=3, sync_interval=2,
+                   seed=7, write_rounds=8),
+        base.with_(fanout=3, max_transmissions=5, sync_interval=1,
+                   seed=11, write_rounds=4),
+        base.with_(fanout=1, max_transmissions=6, sync_interval=0,
+                   seed=3, write_rounds=2),
+    ]
+    p_static, _ = batch.split(scenarios)
+    assert p_static.fanout == 3 and p_static.max_transmissions == 6
+    fleet_vs_solo(scenarios)
+
+
+# -- 2. >= 20-draw property matrix ------------------------------------------
+
+CHAOS_GP = GenParams(
+    n_nodes=20, n_rounds=64, seed=3,
+    partition_frac_ppm=250_000, partition_rounds=6,
+    crash_ppm=40_000, crash_rounds=3, crash_down_rounds=3,
+    drop_ppm=120_000, drop_rounds=10,
+    duplicate_ppm=120_000,
+)
+
+
+def _draw_statics(i: int) -> model.SimParams:
+    """Deterministic statics draw i — lane geometries, topologies, sync
+    budget, SWIM, churn/partition structure; the {unpacked, packed,
+    packed+framed} layout cycles with i."""
+    rng = np.random.default_rng(2000 + i)
+    packed = i % 3 != 0
+    return model.SimParams(
+        n_nodes=int(rng.integers(12, 26)),
+        n_changes=int(rng.integers(5, 14)),
+        fanout=2,
+        max_transmissions=3,
+        sync_interval=2,
+        write_rounds=2,
+        max_rounds=80,
+        nseq_max=int(rng.choice([1, 2, 4])),
+        fanout_per_change=bool(i % 2),
+        topology=[model.COMPLETE, model.ER][i % 2],
+        er_degree=6,
+        swim=bool(rng.integers(0, 2)),
+        sync_chunk_budget=int(rng.choice([0, 3])),
+        seed=0,
+        packed=packed,
+        framed=packed and i % 3 == 2,
+    )
+
+
+def _draw_sweep(p, i: int):
+    """Two random sweep points over p's statics (the fleet's two lanes)."""
+    rng = np.random.default_rng(3000 + i)
+    return [
+        p.with_(
+            fanout=int(rng.integers(1, 4)),
+            max_transmissions=int(rng.choice([2, 3, 5])),
+            sync_interval=int(rng.choice([0, 2, 3])),
+            write_rounds=int(rng.integers(1, 4)),
+            seed=int(rng.integers(0, 1 << 16)),
+        )
+        for _ in range(2)
+    ]
+
+
+@pytest.mark.parametrize("i", range(20))
+def test_fleet_property_sweep(i):
+    statics = _draw_statics(i)
+    scenarios = _draw_sweep(statics, i)
+    chaos = None
+    if i % 4 == 0:
+        # chaos lanes: drop + duplicate links, crashes, a partition —
+        # same lowered schedule each lane (per-lane schedules are
+        # exercised by test_fleet_stack_* and the baseline configs)
+        sched = generate(CHAOS_GP)
+        scenarios = [
+            s.with_(n_nodes=CHAOS_GP.n_nodes) for s in scenarios
+        ]
+        lw = lower(sched, horizon=scenarios[0].max_rounds)
+        chaos = [lw] * len(scenarios)
+    fleet_vs_solo(scenarios, chaos=chaos)
+
+
+# -- 3. lane independence ---------------------------------------------------
+
+
+def test_mutating_one_lane_leaves_others_byte_identical():
+    p = small_configs()["config2_er"].with_(
+        n_nodes=40, max_rounds=64, packed=True, framed=True
+    )
+    scenarios = [p.with_(seed=s) for s in (7, 11, 23)]
+    p_static, sweep = batch.split(scenarios)
+    a = fleetrun.run_fleet(p_static, sweep, return_state=True, n_rounds=48)
+    scenarios[1] = p.with_(seed=999)
+    p_static2, sweep2 = batch.split(scenarios)
+    b = fleetrun.run_fleet(p_static2, sweep2, return_state=True, n_rounds=48)
+    # lane 1 genuinely changed...
+    assert not (
+        int(a.rounds[1]) == int(b.rounds[1])
+        and (np.asarray(a.state[0])[1] == np.asarray(b.state[0])[1]).all()
+    )
+    # ...while lanes 0 and 2 are byte-identical in outcome, state and
+    # telemetry (the counter RNG keys on the lane's own seed only)
+    for i in (0, 2):
+        assert int(a.rounds[i]) == int(b.rounds[i])
+        assert bool(a.converged[i]) == bool(b.converged[i])
+        for xa, xb in zip(a.state, b.state):
+            assert (np.asarray(xa)[i] == np.asarray(xb)[i]).all()
+        assert (a.telemetry[i] == b.telemetry[i]).all()
+
+
+# -- 4. split/stack contracts -----------------------------------------------
+
+
+def test_split_rejects_mismatched_shape_static_by_name():
+    a = small_configs()["config1_ring3"]
+    with pytest.raises(ValueError, match="n_nodes"):
+        batch.split([a, a.with_(n_nodes=a.n_nodes + 1)])
+    with pytest.raises(ValueError, match="nseq_max"):
+        batch.split([a, a.with_(nseq_max=a.nseq_max + 1)])
+    # swept fields may differ freely
+    p_static, sweep = batch.split([a, a.with_(seed=9, fanout=2)])
+    assert sweep.n_scenarios == 2
+    assert p_static.fanout == max(a.fanout, 2)
+
+
+def test_stack_planes_hashes_and_guards():
+    gp = GenParams(
+        n_nodes=16, n_rounds=32, seed=1,
+        crash_ppm=50_000, crash_rounds=4, crash_down_rounds=2,
+        drop_ppm=100_000, drop_rounds=6,
+    )
+    la = lower(generate(gp), horizon=32)
+    lb = lower(generate(GenParams(n_nodes=16, n_rounds=32, seed=2)), horizon=32)
+    planes, hashes = LoweredChaos.stack([la, lb])
+    assert hashes == [la.schedule.schedule_hash(), lb.schedule.schedule_hash()]
+    assert planes["dead"].shape == (2, 32, 16)
+    assert planes["seed"].dtype == np.uint32
+    # lane b has no link faults: its drop plane rides exact zeros
+    assert "drop_ppm" in planes and (planes["drop_ppm"][1] == 0).all()
+    assert (planes["drop_ppm"][0] == np.asarray(la.drop_ppm)).all()
+    with pytest.raises(ValueError, match="equal horizons"):
+        LoweredChaos.stack([la, lower(generate(gp), horizon=40)])
+    with pytest.raises(ValueError, match="cluster sizes"):
+        LoweredChaos.stack(
+            [la, lower(generate(GenParams(n_nodes=8, n_rounds=32, seed=2)),
+                       horizon=32)]
+        )
+
+
+# -- 5. packed budget-lane validation ---------------------------------------
+
+
+def test_packed_max_transmissions_cap_names_the_field():
+    with pytest.raises(ValueError, match="max_transmissions"):
+        model.SimParams(n_nodes=8, n_changes=2, packed=True,
+                        max_transmissions=16, seed=0)
+    # with_() re-validates: widening past the cap on a packed config
+    # must fail the same way, not silently corrupt 4-bit budget lanes
+    p = model.SimParams(n_nodes=8, n_changes=2, packed=True,
+                        max_transmissions=15, seed=0)
+    with pytest.raises(ValueError, match="max_transmissions"):
+        p.with_(max_transmissions=16)
+    assert p.with_(packed=False).with_(max_transmissions=16).packed is False
+
+
+# -- 6. tuner acceptance demo (config 2's stalled corner) -------------------
+
+
+def test_tuner_flags_config2_stall_and_recommends_neighbor():
+    """PR 5's flight recorder caught config 2 at reduced scale stalling
+    at round 13 (budget-exhausted broadcast, sync off, coverage 0.9984).
+    The tuner must reproduce that strand from the fleet telemetry, flag
+    the (max_transmissions=6, sync_interval=0) corner out of the
+    frontier, and recommend a converging neighbor."""
+    # max_rounds=96 (vs config 2's 256): the stall shows inside 40 rounds
+    # and every scanned round costs every lane under vmap
+    base = model.config2_er1k(seed=0).with_(n_nodes=100, max_rounds=96)
+    res = tune(
+        base,
+        fanouts=[3],
+        max_transmissions=[3, 6],
+        sync_intervals=[0, 2],
+        seeds_per_point=2,
+        max_rungs=1,
+    )
+    assert res.compiles == res.rungs == 1  # one fleet batch, one compile
+    bad = [
+        tp for tp in res.flagged
+        if tp.max_transmissions == 6 and tp.sync_interval == 0
+    ]
+    assert bad, "the budget-starved corner must be flagged non-converging"
+    assert 13 in bad[0].stalled_at  # PR 5's strand, reproduced
+    rec = res.recommended
+    assert rec is not None and rec.all_converged
+    assert (rec.max_transmissions, rec.sync_interval) != (6, 0)
+    assert rec.mean_bytes is not None
+    # the recommendation is minimal-bytes among fully-converging points
+    for tp in res.points:
+        if tp.all_converged:
+            assert rec.mean_bytes <= tp.mean_bytes
+
+
+# -- artifact + telemetry block ---------------------------------------------
+
+
+def test_fleet_artifact_and_telemetry_block(tmp_path):
+    p = small_configs()["config1_ring3"].with_(packed=True, framed=True)
+    scenarios = [p.with_(seed=s) for s in (7, 13)]
+    p_static, sweep = batch.split(scenarios)
+    res = fleetrun.run_fleet(p_static, sweep)
+    assert res.telemetry.shape == (
+        2, p_static.max_rounds, len(TELEMETRY_FIELDS)
+    )
+    # per-lane series must match the solo flight recorder's rows
+    from corrosion_tpu.sim import flight
+
+    solo = flight.record_run(batch.lane_params(p_static, sweep, 0))
+    fi = TELEMETRY_FIELDS.index("complete_pairs")
+    assert (
+        list(res.telemetry[0, : solo.rounds, fi])
+        == solo.flight.series["complete_pairs"]
+    )
+    path = tmp_path / "FLEET_test.json"
+    fleetrun.write_artifact(res, str(path))
+    import json
+
+    doc = json.loads(path.read_text())
+    assert doc["fleet"] == 1 and doc["n_scenarios"] == 2
+    lanes = doc["scenarios"]
+    assert [ln["seed"] for ln in lanes] == [7, 13]
+    for i, ln in enumerate(lanes):
+        assert ln["rounds"] == int(res.rounds[i])
+        assert ln["converged"] == bool(res.converged[i])
+        curve = flight.expand_curve(ln["coverage_rle"])
+        assert len(curve) == ln["rounds"]
+        if ln["converged"]:
+            assert curve[-1] == 1.0 and ln["stalled_at"] is None

@@ -15,6 +15,10 @@ vs_baseline = 60 / value (>1 ⇒ beats the north-star bound).
 cache = whether the run compiled fresh ("cold": it added entries to the
 persistent compilation cache) or was served from it ("warm") — so a
 dashboard never mistakes a cache-hit run's `value` for a cold headline.
+aot = whether the headline executable came out of the AOT artifact
+cache ("hit": sim/aot.py served a serialized executable, no lowering or
+compilation at all) or had to be built this invocation ("miss");
+aot_artifact_bytes is the serialized artifact size on disk.
 
 Extra diagnostics go to stderr; `--config N` restricts to a single
 BASELINE config, `--scale F` scales node count (dev/debug).
@@ -67,6 +71,7 @@ def run_config(
     cache_dir: str,
     packed: bool = True,
     framed: bool = True,
+    aot=None,
 ) -> dict:
     from corrosion_tpu.sim import cluster, crdt, flight, model, profile, reference
 
@@ -93,7 +98,7 @@ def run_config(
         partition_rounds=min(p.partition_rounds, 8),
     )
     ref = reference.run_reference(small)
-    got = cluster.run(small)
+    got = cluster.run(small, return_state=True, aot=aot)
     assert got.rounds == ref.rounds and got.converged == ref.converged, (
         f"fidelity check failed: jax={got.rounds} ref={ref.rounds}"
     )
@@ -102,14 +107,37 @@ def run_config(
         f"ref={ref.rounds} (exact match)"
     )
 
-    res = cluster.run(p, return_state=True)
+    # checkpoint/resume spot-check at the same reduced scale: run to the
+    # midpoint, snapshot the carry, resume — must land bit-identically on
+    # the uninterrupted run (the full matrix is tests/test_sim_aot.py)
+    import numpy as np
+
+    mid = max(1, got.rounds // 2)
+    part = cluster.run(small.with_(max_rounds=mid), return_state=True, aot=aot)
+    resumed = cluster.run(
+        small, initial_state=part.state, return_state=True, aot=aot
+    )
+    resume_ok = resumed.rounds == got.rounds and all(
+        np.array_equal(a, b) for a, b in zip(resumed.state, got.state)
+    )
+    assert resume_ok, (
+        f"resume diverged: {resumed.rounds} vs {got.rounds} after "
+        f"checkpoint at round {mid}"
+    )
+    log(f"resume @n={small.n_nodes}: checkpoint at round {mid}, bit-identical")
+
+    res = cluster.run(p, return_state=True, aot=aot)
     cache_state = (
         "cold" if _cache_entries(cache_dir) > entries_before else "warm"
     )
+    # AOT verdict for the headline run: "hit" when the executable came
+    # out of the artifact cache (memory or disk), "miss" when this
+    # invocation had to lower+compile it (sim/aot.py)
+    aot_state = "hit" if res.aot in ("memory", "disk") else "miss"
     log(
         f"run: converged={res.converged} rounds={res.rounds} "
         f"compile={res.compile_s:.2f}s execute={res.wall_s:.2f}s "
-        f"cache={cache_state}"
+        f"cache={cache_state} aot={res.aot or 'off'}"
     )
 
     # CRDT merge on the final state: every node must agree on every LWW
@@ -135,7 +163,7 @@ def run_config(
     # warm re-run: with the jit/persistent cache primed this measures the
     # marginal cost of another convergence run — the number that actually
     # scales (compile is a one-time cost the cold `value` includes)
-    warm = cluster.run(p)
+    warm = cluster.run(p, aot=aot)
     assert warm.converged == res.converged and warm.rounds == res.rounds
     warm_total = warm.compile_s + warm.wall_s
     log(
@@ -157,7 +185,7 @@ def run_config(
     # idle to max_rounds); non-perturbation means its round count MUST
     # match the while_loop's — a cheap end-to-end recorder check on
     # every bench run
-    fres = flight.record_run(p, n_rounds=res.rounds)
+    fres = flight.record_run(p, n_rounds=res.rounds, aot=aot)
     assert fres.rounds == res.rounds and fres.converged == res.converged, (
         f"flight recorder perturbed the run: {fres.rounds} vs {res.rounds}"
     )
@@ -181,6 +209,9 @@ def run_config(
         "warm_s": round(warm_total, 3),
         "warm_execute_s": round(warm.wall_s, 3),
         "cache": cache_state,
+        "aot": aot_state,
+        "aot_artifact_bytes": res.aot_bytes,
+        "resume_ok": resume_ok,
         "device": dev.platform,
     }
     out.update(profile.bench_fields(prof))
@@ -207,7 +238,8 @@ def run_config(
 
 
 def run_fleet_bench(seed: int, scale: float, dev, cache_dir: str,
-                    packed: bool = True, framed: bool = True) -> dict:
+                    packed: bool = True, framed: bool = True,
+                    aot=None) -> dict:
     """64-scenario config-3-regime sweep as ONE compiled program.
 
     8 knob points (fanout × max_transmissions × sync_interval neighbors
@@ -241,7 +273,7 @@ def run_fleet_bench(seed: int, scale: float, dev, cache_dir: str,
     # solo cold reference FIRST (its program must not be in this
     # invocation's cache window when we count the fleet's entries): one
     # lane, fresh compile — the per-point cost a naive sweep pays 64×
-    solo = cluster.run(batch.lane_params(p_static, sweep, 0))
+    solo = cluster.run(batch.lane_params(p_static, sweep, 0), aot=aot)
     solo_total = solo.compile_s + solo.wall_s
     log(
         f"solo cold lane 0: total={solo_total:.2f}s "
@@ -255,19 +287,26 @@ def run_fleet_bench(seed: int, scale: float, dev, cache_dir: str,
     horizon = min(p.max_rounds, max(64, 4 * solo.rounds))
 
     entries_before = _cache_executables(cache_dir)
-    res = fleetrun.run_fleet(p_static, sweep, n_rounds=horizon)
+    misses_before = None if aot is None else aot.misses
+    res = fleetrun.run_fleet(p_static, sweep, n_rounds=horizon, aot=aot)
     entries_added = _cache_executables(cache_dir) - entries_before
     fleetrun.publish_metrics(res)
     fleet_total = res.compile_s + res.wall_s
     log(
         f"fleet: converged={int(res.converged.sum())}/{res.n_scenarios} "
         f"compile={res.compile_s:.2f}s execute={res.wall_s:.2f}s "
-        f"cache_entries_added={entries_added}"
+        f"cache_entries_added={entries_added} aot={res.aot or 'off'}"
     )
-    assert entries_added <= 1, (
-        f"fleet should be ONE compiled program, added {entries_added} "
-        "cache entries"
-    )
+    # ONE compiled program for the whole batch.  Gate on the AOT cache's
+    # miss counter — the XLA cache-entry delta still gets stamped below,
+    # but it now also counts the host-side batched init_state's eager
+    # ops (one tiny entry per state plane), so it can't be the gate.
+    if misses_before is not None:
+        fleet_misses = aot.misses - misses_before
+        assert fleet_misses <= 1, (
+            f"fleet should be ONE compiled program, AOT built "
+            f"{fleet_misses} executables"
+        )
     solo_sum = 64 * solo_total
     conv = res.bytes_to_convergence[res.converged]
     return {
@@ -285,6 +324,8 @@ def run_fleet_bench(seed: int, scale: float, dev, cache_dir: str,
         "per_lane_rounds": [int(r) for r in res.rounds],
         "bytes_to_convergence_min": int(conv.min()) if conv.size else None,
         "cache_entries_added": entries_added,
+        "aot": "hit" if res.aot in ("memory", "disk") else "miss",
+        "aot_artifact_bytes": res.aot_bytes,
         "solo_cold_s": round(solo_total, 3),
         "solo_rounds": solo.rounds,
         "solo_sum_est_s": round(solo_sum, 3),
@@ -318,6 +359,14 @@ def main() -> None:
         "(default: bounded message frames + segment-combine, sim/frames.py)",
     )
     ap.add_argument(
+        "--aot-dir",
+        default=None,
+        help="AOT executable-artifact directory (sim/aot.py; default: "
+        ".aot_cache beside this script).  Prime it with one cold run; "
+        "subsequent runs then skip lowering+compilation entirely and "
+        "stamp aot='hit' on their JSON lines.",
+    )
+    ap.add_argument(
         "--fleet",
         action="store_true",
         help="run the 64-scenario config-3-regime fleet sweep instead of "
@@ -343,13 +392,25 @@ def main() -> None:
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
 
+    # AOT artifact tier (sim/aot.py): serialized executables keyed by
+    # shape/params/version — a primed dir skips lower+compile outright,
+    # which the persistent XLA cache above cannot (it only skips the
+    # backend compile, not tracing/lowering)
+    from corrosion_tpu.sim.aot import AotCache
+
+    aot_dir = args.aot_dir or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".aot_cache"
+    )
+    aot = AotCache(cache_dir=aot_dir)
+    log(f"aot artifact dir: {aot_dir}")
+
     packed = not args.unpacked
     framed = not args.dense
 
     if args.fleet:
         out = run_fleet_bench(
             args.seed, args.scale, dev, cache_dir,
-            packed=packed, framed=framed,
+            packed=packed, framed=framed, aot=aot,
         )
         print(json.dumps(out), flush=True)
         log(
@@ -381,7 +442,7 @@ def main() -> None:
             if dev.platform != "cpu" and limit >= 1.5 * need:
                 out = run_config(
                     4, args.seed, 10.0, dev, cache_dir,
-                    packed=packed, framed=framed,
+                    packed=packed, framed=framed, aot=aot,
                 )
                 print(json.dumps(out), flush=True)
             else:
@@ -392,7 +453,7 @@ def main() -> None:
                 )
         out = run_config(
             n, args.seed, args.scale, dev, cache_dir,
-            packed=packed, framed=framed,
+            packed=packed, framed=framed, aot=aot,
         )
         print(json.dumps(out), flush=True)
     log(f"total harness wall (incl. imports): {time.perf_counter()-t_all:.2f}s")

@@ -5,6 +5,8 @@ Three cooperating passes (see doc/lint.md for the rule catalogue):
 1. JAX trace-safety (GL1xx) over ``sim/`` and ``crdt/``
 2. async lock discipline (GL2xx) over the agent runtime
 3. abstract shape/dtype contracts (GL3xx) via ``jax.eval_shape``
+4. buffer donation (GL4xx) over the device-program dirs (``sim/``,
+   ``crdt/``, ``fleet/``)
 
 Entry point: ``python -m corrosion_tpu.cli lint [--json] [--fail-on=...]``
 or :func:`lint_repo` / :func:`lint_paths` from code.
@@ -15,7 +17,7 @@ from __future__ import annotations
 import os
 from typing import List, Optional, Sequence
 
-from . import async_discipline, contracts, trace_safety
+from . import async_discipline, contracts, donation, trace_safety
 from .report import exit_code, render_json, render_text, severity_counts
 from .rules import RULES, Finding, sort_findings
 from .suppress import apply_suppressions, scan_suppressions
@@ -23,6 +25,7 @@ from .suppress import apply_suppressions, scan_suppressions
 # Pass scopes, relative to the package root (corrosion_tpu/).
 TRACE_SAFETY_DIRS = ("sim", "crdt")
 ASYNC_DIRS = ("agent", "swim", "sync", "broadcast", "transport")
+DONATION_DIRS = ("sim", "crdt", "fleet")
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -51,6 +54,8 @@ def lint_file(path: str, repo_root: Optional[str] = None) -> List[Finding]:
         findings.extend(trace_safety.check_source(rel, source))
     if scope in ASYNC_DIRS or scope is None:
         findings.extend(async_discipline.check_source(rel, source))
+    if scope in DONATION_DIRS or scope is None:
+        findings.extend(donation.check_source(rel, source))
     sups, meta = scan_suppressions(rel, source)
     findings = apply_suppressions(findings, sups)
     findings.extend(meta)
@@ -80,7 +85,10 @@ def lint_repo(
     ``--self-check`` run."""
     root = repo_root or os.path.dirname(_PKG_ROOT)
     findings: List[Finding] = []
-    for path in _py_files(root, TRACE_SAFETY_DIRS + ASYNC_DIRS):
+    walked = tuple(
+        dict.fromkeys(TRACE_SAFETY_DIRS + ASYNC_DIRS + DONATION_DIRS)
+    )
+    for path in _py_files(root, walked):
         findings.extend(lint_file(path, root))
     if with_contracts:
         findings.extend(contracts.check_transition())

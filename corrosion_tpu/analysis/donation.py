@@ -1,0 +1,64 @@
+"""Buffer-donation pass (GL401) over the device-program dirs.
+
+Every ``jax.jit`` / ``pjit`` call in ``sim/``, ``crdt/`` and ``fleet/``
+is a candidate hot entry point: the state carry it closes over is the
+dominant memory object in the program (the packed 1M-node carry is
+~202 MB), and without ``donate_argnums``/``donate_argnames`` XLA must
+keep the input AND output copies live across the call.  The rule is
+deliberately syntactic — flag any jit call without a donation keyword —
+because whether donation is *correct* is a host-side calling-convention
+fact the AST cannot see; the escape hatch is the standard reasoned
+suppression (``# graftlint: disable=GL401 (...)``), which doubles as
+in-place documentation of why a given entry point must not alias
+(e.g. sim/profile.py's bandwidth probes re-time the same input buffer).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .rules import Finding, GL401
+
+_JIT_NAMES = {"jit", "pjit"}
+_DONATE_KEYWORDS = {"donate_argnums", "donate_argnames"}
+
+
+def _func_name(node: ast.expr) -> Optional[str]:
+    """Trailing name of a call target: jax.jit -> 'jit', jit -> 'jit'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def check_source(path: str, source: str) -> List[Finding]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _func_name(node.func) not in _JIT_NAMES:
+            continue
+        kw_names = {kw.arg for kw in node.keywords}
+        if kw_names & _DONATE_KEYWORDS:
+            continue
+        findings.append(
+            Finding(
+                path=path,
+                line=node.lineno,
+                rule=GL401.id,
+                severity=GL401.severity,
+                message=(
+                    "jit call without donate_argnums/donate_argnames: the "
+                    "state carry's input copy stays live across the call "
+                    "(suppress with a reason if the caller reuses the "
+                    "input buffer)"
+                ),
+            )
+        )
+    return findings

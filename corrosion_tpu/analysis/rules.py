@@ -207,6 +207,23 @@ GL303 = _rule(
     "use-after-trace crashes.",
 )
 
+# -- buffer donation ----------------------------------------------------------
+
+GL401 = _rule(
+    "GL401",
+    WARNING,
+    "jit entry point without buffer donation",
+    "A hot-path jax.jit that carries the sim state without "
+    "donate_argnums/donate_argnames keeps both the input and output "
+    "copies of the carry live across the call — the packed 1M-node "
+    "carry is ~202 MB, so the missing alias doubles peak HBM and adds "
+    "a full device copy per invocation (sim/aot.py routes the entry "
+    "points through donated executables for exactly this reason).  "
+    "Suppress with a reason where donation is genuinely wrong: the "
+    "caller reuses the input buffer across calls (bandwidth probes, "
+    "profiling reps) or the output must not alias the input.",
+)
+
 
 def sort_findings(findings: List[Finding]) -> List[Finding]:
     return sorted(findings, key=Finding.key)

@@ -197,14 +197,27 @@ class _TracedNames:
     # Host-scalar annotations mark a parameter as STATIC: the repo's
     # convention for trace-time-constant ints threaded into pure bodies
     # (attempt/slot indices in sim/cluster.py's draw functions).
+    # ``Optional[int]`` and friends count too — None-or-host-scalar is
+    # still a trace-time constant (sim/cluster.py init_state's ``batch``).
     _STATIC_ANNOTATIONS = {"int", "bool", "str"}
+
+    @classmethod
+    def _static_annotation(cls, ann) -> bool:
+        if isinstance(ann, ast.Name):
+            return ann.id in cls._STATIC_ANNOTATIONS
+        if (
+            isinstance(ann, ast.Subscript)
+            and isinstance(ann.value, ast.Name)
+            and ann.value.id == "Optional"
+        ):
+            return cls._static_annotation(ann.slice)
+        return False
 
     def __init__(self, fn: ast.FunctionDef):
         self.names: Set[str] = set()
         args = fn.args
         for a in args.posonlyargs + args.args + args.kwonlyargs:
-            ann = a.annotation
-            if isinstance(ann, ast.Name) and ann.id in self._STATIC_ANNOTATIONS:
+            if self._static_annotation(a.annotation):
                 continue
             self.names.add(a.arg)
         if args.vararg:

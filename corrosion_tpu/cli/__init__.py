@@ -512,8 +512,32 @@ async def cmd_sim(args) -> int:
         if args.scale != 1.0:
             p = p.with_(n_nodes=max(8, int(p.n_nodes * args.scale)))
         p = p.with_(packed=not args.unpacked)
-        res = flight.record_run(p, n_rounds=args.rounds)
+        aot = None
+        if args.aot_dir:
+            from ..sim.aot import AotCache
+
+            aot = AotCache(cache_dir=args.aot_dir)
+        initial_state = None
+        if args.resume:
+            from ..sim import cluster
+
+            initial_state = cluster.load_state(args.resume)
+        res = flight.record_run(
+            p,
+            n_rounds=args.rounds,
+            aot=aot,
+            initial_state=initial_state,
+            return_state=bool(args.checkpoint),
+        )
         flight.publish_metrics(res.flight)
+        if args.checkpoint:
+            from ..sim import cluster
+
+            cluster.save_state(res.state, args.checkpoint)
+            print(
+                f"checkpointed round {res.rounds} carry to {args.checkpoint}",
+                file=sys.stderr,
+            )
         if args.out:
             with open(args.out, "w", encoding="utf-8") as f:
                 f.write(flight.to_ndjson(res.flight))
@@ -542,6 +566,11 @@ async def cmd_fleet(args) -> int:
     if args.scale != 1.0:
         p = p.with_(n_nodes=max(8, int(p.n_nodes * args.scale)))
     p = p.with_(packed=not args.unpacked)
+    aot = None
+    if getattr(args, "aot_dir", None):
+        from ..sim.aot import AotCache
+
+        aot = AotCache(cache_dir=args.aot_dir)
     fanouts = _ints(args.fanouts) if args.fanouts else [p.fanout]
     mts = _ints(args.max_tx) if args.max_tx else [p.max_transmissions]
     sis = (
@@ -564,7 +593,7 @@ async def cmd_fleet(args) -> int:
             for k in range(args.scenarios)
         ]
         p_static, sweep = batch.split(scenarios)
-        res = fleetrun.run_fleet(p_static, sweep)
+        res = fleetrun.run_fleet(p_static, sweep, aot=aot)
         fleetrun.publish_metrics(res)
         if args.out:
             fleetrun.write_artifact(res, args.out)
@@ -600,6 +629,7 @@ async def cmd_fleet(args) -> int:
             seeds_per_point=args.seeds_per_point,
             eta=args.eta,
             max_rungs=args.rungs,
+            aot=aot,
         )
         print(frontier_markdown(res))
         if res.recommended is None:
@@ -848,6 +878,16 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--load", default=None,
                     help="summarize an existing NDJSON artifact instead "
                     "of running")
+    tr.add_argument("--aot-dir", default=None,
+                    help="serve/store AOT executable artifacts here "
+                    "(sim/aot.py; a primed dir skips compilation)")
+    tr.add_argument("--resume", default=None, metavar="NPZ",
+                    help="resume from a state checkpoint (npz written by "
+                    "--checkpoint); continues bit-identically from the "
+                    "snapshotted round")
+    tr.add_argument("--checkpoint", default=None, metavar="NPZ",
+                    help="write the final scan carry here for a later "
+                    "--resume")
     sp.set_defaults(fn=cmd_sim)
 
     sp = sub.add_parser(
@@ -884,6 +924,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="comma list of max_transmissions values")
         fp.add_argument("--sync-intervals", default=None,
                         help="comma list of sync_interval values")
+        fp.add_argument("--aot-dir", default=None,
+                        help="serve/store AOT executable artifacts here "
+                        "(sim/aot.py; repeat sweeps/rungs with the same "
+                        "lane count reuse one executable)")
         if name == "run":
             fp.add_argument(
                 "--scenarios", type=int, default=8,

@@ -65,6 +65,8 @@ class FleetResult:
     compile_s: float
     state: Optional[tuple] = None  # stacked final state when requested
     schedule_hashes: Optional[List[str]] = None
+    aot: Optional[str] = None  # "compile" | "disk" | "memory" (sim/aot.py)
+    aot_bytes: int = 0  # serialized artifact size on disk
 
     @property
     def n_scenarios(self) -> int:
@@ -76,6 +78,7 @@ def run_fleet(
     sweep: SweepParams,
     return_state: bool = False,
     n_rounds: Optional[int] = None,
+    aot=None,
 ) -> FleetResult:
     """Execute one fleet batch (one compile, B lanes).
 
@@ -86,26 +89,36 @@ def run_fleet(
     ``max_rounds`` (bench.py --fleet passes a measured bound so 64
     lanes don't idle to config 3's 512-round ceiling; under ``vmap``
     the done-gate is a ``select``, so every lane pays every scanned
-    round)."""
+    round).
+
+    The executable is cached through sim/aot.py (``aot``; default the
+    process-wide cache): knobs and chaos planes are traced operands and
+    ``init_state`` is seed-independent, so the key is only
+    (p_static, B, R, plane signature) — repeat batches with identical
+    statics (the tuner's rungs) reuse the in-memory executable, and a
+    primed ``CORRO_AOT_DIR`` skips the cold compile entirely.  The
+    batched round-0 carry is built host-side and **donated**, removing
+    a full B-lane state copy from peak HBM."""
+    from ..sim import aot as aotmod
+
+    cache = aotmod.default_cache() if aot is None else aot
     B = sweep.n_scenarios
     R = p_static.max_rounds if n_rounds is None else n_rounds
     zeros = {f: jnp.int32(0) for f in TELEMETRY_FIELDS}
     has_chaos = sweep.chaos_planes is not None
 
-    def lane(kv, chaos_lane=None):
+    def lane(state, kv, chaos_lane=None):
         kn = cluster.Knobs(*kv)
         step = cluster.make_step(
             p_static, telemetry=True, knobs=kn, chaos_arrays=chaos_lane
         )
         full = cluster.full_plane_for(p_static, kn.seed)
 
-        def body(state, _):
-            done = (state[0] == full[None, :]).all()
-            return lax.cond(done, lambda s: (s, zeros), step, state)
+        def body(s, _):
+            done = (s[0] == full[None, :]).all()
+            return lax.cond(done, lambda x: (x, zeros), step, s)
 
-        return lax.scan(
-            body, cluster.init_state(p_static), None, length=R
-        )
+        return lax.scan(body, state, None, length=R)
 
     kvs = (
         jnp.asarray(sweep.seed),
@@ -114,18 +127,36 @@ def run_fleet(
         jnp.asarray(sweep.sync_interval),
         jnp.asarray(sweep.write_rounds),
     )
+    state0 = cluster.init_state(p_static, batch=B)
+    statics = (aotmod.params_key(p_static), ("fleet", B, R))
+
     t0 = time.perf_counter()
     if has_chaos:
         planes = {k: jnp.asarray(v) for k, v in sweep.chaos_planes.items()}
-        fn = jax.jit(jax.vmap(lambda kv, ch: lane(kv, ch)))
-        compiled = fn.lower(kvs, planes).compile()
+
+        def build():
+            return jax.jit(
+                jax.vmap(lambda s, kv, ch: lane(s, kv, ch)),
+                donate_argnums=0,
+            )
+
+        compiled, info = cache.get_or_compile(
+            "fleet.run_fleet", statics, build, (state0, kvs, planes)
+        )
         t1 = time.perf_counter()
-        out, tel = jax.block_until_ready(compiled(kvs, planes))
+        out, tel = jax.block_until_ready(compiled(state0, kvs, planes))
     else:
-        fn = jax.jit(jax.vmap(lambda kv: lane(kv)))
-        compiled = fn.lower(kvs).compile()
+
+        def build():
+            return jax.jit(
+                jax.vmap(lambda s, kv: lane(s, kv)), donate_argnums=0
+            )
+
+        compiled, info = cache.get_or_compile(
+            "fleet.run_fleet", statics, build, (state0, kvs)
+        )
         t1 = time.perf_counter()
-        out, tel = jax.block_until_ready(compiled(kvs))
+        out, tel = jax.block_until_ready(compiled(state0, kvs))
     scanned = np.asarray(out[-1])  # device→host fetch inside the timed region
     t2 = time.perf_counter()
 
@@ -177,6 +208,8 @@ def run_fleet(
         compile_s=t1 - t0,
         state=tuple(out) if return_state else None,
         schedule_hashes=sweep.schedule_hashes,
+        aot=info.source,
+        aot_bytes=info.artifact_bytes,
     )
 
 

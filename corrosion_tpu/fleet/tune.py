@@ -63,7 +63,11 @@ class TuneResult:
     recommended: Optional[TunePoint]  # min mean_bytes among all-converged
     flagged: List[TunePoint]  # dropped for a non-converging seed
     rungs: int
-    compiles: int  # == rungs: one fleet compile per rung
+    # Executables actually built/fetched (sim/aot.py): at most one per
+    # rung, and FEWER when rungs share a batch shape — halving with
+    # eta=2 keeps lane count constant (half the points × double the
+    # seeds), so every rung after the first is an in-memory AOT hit.
+    compiles: int
     fleet_results: List[FleetResult] = field(default_factory=list)
 
 
@@ -101,6 +105,7 @@ def tune(
     eta: int = 2,
     max_rungs: int = 3,
     chaos=None,
+    aot=None,
 ) -> TuneResult:
     """Successive-halving search over the knob grid around ``base``.
 
@@ -109,7 +114,17 @@ def tune(
     set grows ``eta``-fold per rung while the surviving point set
     shrinks ``eta``-fold, so every rung costs about the same lane count.
     ``chaos`` is an optional sim-lowerable ``LoweredChaos`` (horizon ≥
-    ``base.max_rounds``) applied identically to every lane."""
+    ``base.max_rounds``) applied identically to every lane.
+
+    ``aot`` (sim/aot.py AotCache) is shared across rungs — knobs are
+    traced operands, so rungs with the same lane count reuse ONE
+    executable; the default is a private per-call cache so
+    ``TuneResult.compiles`` deterministically counts the executables
+    this search actually fetched."""
+    if aot is None:
+        from ..sim.aot import AotCache
+
+        aot = AotCache()
     grid: List[Point] = [
         (fo, mt, si)
         for fo in fanouts
@@ -139,7 +154,7 @@ def tune(
                 )
         chaos_list = None if chaos is None else [chaos] * len(scenarios)
         p_static, sweep = split(scenarios, chaos=chaos_list)
-        res = run_fleet(p_static, sweep)
+        res = run_fleet(p_static, sweep, aot=aot)
         fleet_results.append(res)
         rung += 1
 
@@ -168,7 +183,7 @@ def tune(
         recommended=recommended,
         flagged=flagged,
         rungs=rung,
-        compiles=rung,
+        compiles=sum(1 for r in fleet_results if r.aot != "memory"),
         fleet_results=fleet_results,
     )
 

@@ -1,0 +1,456 @@
+"""AOT executable cache: compile the hot loops once per key, reuse forever.
+
+Cold XLA compile dominates every bench line (config 3 pays ~6 s of
+compile for 0.3 s of execute, BENCH_r06), and before this module the hot
+entry points — ``cluster.run``'s while_loop, ``flight.record_run``'s
+telemetry scan, the fleet's ``jit(vmap(lane))`` — each rebuilt a fresh
+closure per call, so even in-process repeat runs missed jit's own memory
+cache and only the persistent XLA cache (which still re-lowers and
+re-hashes the HLO every call) softened the blow.
+
+:class:`AotCache` routes an entry point through
+``jax.jit(...).lower(args).compile()`` exactly once per **key** and then
+serves the live ``Compiled`` executable:
+
+- **memory** tier: an LRU of loaded executables — a repeat call with
+  identical statics (the tuner's rungs, the equivalence-matrix tests)
+  skips lowering, cache hashing, everything.
+- **disk** tier (``cache_dir`` argument or ``CORRO_AOT_DIR`` env var):
+  the executable is serialized via
+  ``jax.experimental.serialize_executable`` and pickled to
+  ``<entry>-<key16>.aot``; a fresh process (or a fresh host shipped the
+  artifact dir, doc/ops.md) deserializes in milliseconds instead of
+  recompiling in seconds.
+
+Key schema — the sha256 of:
+
+- ``AOT_FORMAT`` (this module's artifact layout version),
+- the entry-point name and its static description (every ``SimParams``
+  field via :func:`params_key`; scan length / lane count where relevant;
+  the chaos *plane signature* — shapes, dtypes, horizon — but never the
+  schedule's contents, since lowered chaos planes ride the executable as
+  runtime operands),
+- the abstract signature (pytree structure + shape/dtype per leaf) of
+  the example arguments,
+- jax / jaxlib versions, device platform, device kind and device count,
+- a fingerprint of the simulator's own source files (sim/, fleet/,
+  chaos/lower.py) — editing the step logic invalidates every artifact
+  without any version bookkeeping.
+
+Invalidation is purely key-driven: a changed key simply misses and
+compiles fresh.  A *stale or corrupt artifact file* (truncated write,
+pickle from an older ``AOT_FORMAT``, key mismatch after a hash
+collision in the filename prefix) is detected at load, logged to
+stderr, and falls back to a fresh compile that overwrites it — never a
+crash (tests/test_sim_aot.py).
+
+Donation caveat: the cached executables donate their state-carry
+argument (argument 0), so a caller that passes its own ``initial_state``
+hands over ownership — the arrays are dead after the call.  Snapshot to
+npz (``cluster.save_state``) before resuming if the state must survive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import sys
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Artifact layout version: bump when the on-disk pickle schema changes.
+# It feeds both the key hash (so bumped processes never look up old
+# filenames) and the artifact header (so a file overwritten in place by
+# an older process is rejected at load, not deserialized blind).
+AOT_FORMAT = 1
+
+ENV_DIR = "CORRO_AOT_DIR"
+
+_FINGERPRINT: Optional[str] = None
+
+
+def code_fingerprint() -> str:
+    """sha256 over the source files that define the lowered programs
+    (sim/, fleet/, chaos/lower.py).  Any edit to the step logic changes
+    the fingerprint, so stale disk artifacts can never replay an old
+    program against new code — the failure mode the persistent XLA cache
+    avoids by hashing HLO, which we skip lowering to produce."""
+    global _FINGERPRINT
+    if _FINGERPRINT is not None:
+        return _FINGERPRINT
+    here = os.path.dirname(os.path.abspath(__file__))
+    pkg = os.path.dirname(here)
+    files: List[str] = []
+    for sub in ("sim", "fleet"):
+        base = os.path.join(pkg, sub)
+        if os.path.isdir(base):
+            files.extend(
+                os.path.join(base, f)
+                for f in sorted(os.listdir(base))
+                if f.endswith(".py")
+            )
+    lower = os.path.join(pkg, "chaos", "lower.py")
+    if os.path.exists(lower):
+        files.append(lower)
+    h = hashlib.sha256()
+    for path in files:
+        with open(path, "rb") as fh:
+            h.update(path.encode())
+            h.update(fh.read())
+    _FINGERPRINT = h.hexdigest()
+    return _FINGERPRINT
+
+
+def device_fingerprint() -> Tuple[str, ...]:
+    """The platform facts an executable is only valid for: jax/jaxlib
+    versions (serialized executables do not round-trip across them),
+    backend platform, device kind and visible device count."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    return (
+        jax.__version__,
+        jaxlib.__version__,
+        dev.platform,
+        str(getattr(dev, "device_kind", "?")),
+        str(jax.device_count()),
+    )
+
+
+def params_key(p) -> Tuple[Tuple[str, Any], ...]:
+    """Every SimParams field as a sorted, hashable item tuple — the
+    shape-bucket-plus-flags part of the key."""
+    return tuple(sorted(dataclasses.asdict(p).items()))
+
+
+def abstract_sig(args: Tuple) -> Tuple:
+    """Pytree structure plus per-leaf (shape, dtype) of the example
+    arguments — what ``lower`` specializes on besides the closure."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (
+        str(treedef),
+        tuple(
+            (np.shape(x), str(getattr(x, "dtype", type(x).__name__)))
+            for x in leaves
+        ),
+    )
+
+
+@dataclass
+class AotEntry:
+    """How one ``get_or_compile`` call was served."""
+
+    source: str  # "compile" | "disk" | "memory"
+    key: str  # full sha256 hex of the key material
+    path: Optional[str]  # disk artifact path (None when memory-only)
+    artifact_bytes: int  # serialized size on disk (0 when not persisted)
+
+
+class AotCache:
+    """Two-tier (memory LRU + optional disk) cache of compiled
+    executables, keyed as described in the module docstring."""
+
+    def __init__(
+        self,
+        cache_dir: Optional[str] = None,
+        max_memory_entries: int = 64,
+    ):
+        if cache_dir is None:
+            cache_dir = os.environ.get(ENV_DIR) or None
+        self.cache_dir = cache_dir
+        self.max_memory_entries = max_memory_entries
+        # key -> (callable, path, artifact_bytes), LRU order
+        self._mem: "OrderedDict[str, Tuple[Callable, Optional[str], int]]" = (
+            OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+
+    # -- keys ---------------------------------------------------------------
+
+    def key_for(self, entry: str, statics: Tuple, args: Tuple) -> str:
+        material = repr(
+            (
+                AOT_FORMAT,
+                entry,
+                statics,
+                abstract_sig(args),
+                device_fingerprint(),
+                code_fingerprint(),
+            )
+        )
+        return hashlib.sha256(material.encode()).hexdigest()
+
+    def path_for(self, entry: str, key: str) -> Optional[str]:
+        if not self.cache_dir:
+            return None
+        safe = "".join(c if c.isalnum() else "_" for c in entry)
+        return os.path.join(self.cache_dir, f"{safe}-{key[:24]}.aot")
+
+    # -- the one entry point ------------------------------------------------
+
+    def get_or_compile(
+        self,
+        entry: str,
+        statics: Tuple,
+        build: Callable[[], Any],
+        args: Tuple,
+        persist: bool = True,
+    ) -> Tuple[Callable, AotEntry]:
+        """Return ``(executable, AotEntry)`` for ``build()`` specialized
+        on ``args``.  ``build`` must return a ``jax.jit`` object whose
+        program depends only on ``statics`` and the abstract signature
+        of ``args`` (chaos planes and knobs are operands, never closure
+        constants, exactly so this holds).  ``persist=False`` keeps the
+        executable memory-only (sharded mesh programs: their serialized
+        form bakes in a device assignment this host may not have)."""
+        key = self.key_for(entry, statics, args)
+        hit = self._mem.get(key)
+        if hit is not None:
+            self._mem.move_to_end(key)
+            self.hits += 1
+            return hit[0], AotEntry("memory", key, hit[1], hit[2])
+        path = self.path_for(entry, key) if persist else None
+        if path and os.path.exists(path):
+            fn = self._load(path, key)
+            if fn is not None:
+                size = os.path.getsize(path)
+                self._remember(key, fn, path, size)
+                self.hits += 1
+                return fn, AotEntry("disk", key, path, size)
+        if path:
+            compiled = self._compile_uncached(build, args)
+        else:
+            compiled = build().lower(*args).compile()
+        size = self._dump(compiled, path, key) if path else 0
+        self._remember(key, compiled, path, size)
+        self.misses += 1
+        return compiled, AotEntry("compile", key, path, size)
+
+    def clear_memory(self) -> None:
+        self._mem.clear()
+
+    @staticmethod
+    def _compile_uncached(build: Callable[[], Any], args: Tuple):
+        """Compile bypassing the persistent XLA compilation cache.  An
+        executable *served* from that cache serializes into a blob whose
+        compiled object code is incomplete — it deserializes to "Symbols
+        not found" in every other process — so anything destined for a
+        disk artifact must come from a genuinely fresh compile.
+
+        The enable flag alone is not enough: jax memoizes cache-in-use
+        per process on first compile (``compilation_cache.is_cache_used``
+        latches ``_cache_used``), so if *any* earlier jit in this process
+        touched the persistent cache the flag flip is ignored.  Reset the
+        latch around the flip, both ways."""
+        import jax
+
+        try:
+            from jax._src import compilation_cache as _cc
+        except Exception:  # pragma: no cover - internals moved
+            _cc = None
+
+        prev = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        if _cc is not None:
+            _cc.reset_cache()
+        try:
+            return build().lower(*args).compile()
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
+            if _cc is not None:
+                _cc.reset_cache()
+
+    # -- internals ----------------------------------------------------------
+
+    def _remember(
+        self, key: str, fn: Callable, path: Optional[str], size: int
+    ) -> None:
+        self._mem[key] = (fn, path, size)
+        self._mem.move_to_end(key)
+        while len(self._mem) > self.max_memory_entries:
+            self._mem.popitem(last=False)
+
+    def _dump(self, compiled, path: str, key: str) -> int:
+        """Serialize to disk; any failure (unserializable program, full
+        disk) downgrades to memory-only with a stderr note."""
+        try:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled
+            )
+            blob = pickle.dumps(
+                {
+                    "format": AOT_FORMAT,
+                    "key": key,
+                    "device": device_fingerprint(),
+                    "payload": payload,
+                    "in_tree": in_tree,
+                    "out_tree": out_tree,
+                }
+            )
+            os.makedirs(self.cache_dir, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+            os.replace(tmp, path)  # atomic: readers never see a torn file
+            return len(blob)
+        except Exception as e:  # pragma: no cover - env-dependent
+            print(f"aot: serialize failed ({e}); memory-only", file=sys.stderr)
+            return 0
+
+    def _load(self, path: str, key: str) -> Optional[Callable]:
+        """Deserialize a disk artifact; anything wrong with it — corrupt
+        pickle, older AOT_FORMAT, key mismatch, jaxlib refusing the
+        payload — returns None so the caller recompiles and overwrites."""
+        try:
+            with open(path, "rb") as fh:
+                doc = pickle.load(fh)
+            if not isinstance(doc, dict):
+                raise ValueError("artifact is not a dict")
+            if doc.get("format") != AOT_FORMAT:
+                raise ValueError(
+                    f"artifact format {doc.get('format')} != {AOT_FORMAT}"
+                )
+            if doc.get("key") != key:
+                raise ValueError("artifact key mismatch (stale file)")
+            from jax.experimental import serialize_executable
+
+            return serialize_executable.deserialize_and_load(
+                doc["payload"], doc["in_tree"], doc["out_tree"]
+            )
+        except Exception as e:
+            print(
+                f"aot: stale/corrupt artifact {os.path.basename(path)} "
+                f"({e}); recompiling",
+                file=sys.stderr,
+            )
+            return None
+
+
+_default: Optional[AotCache] = None
+
+
+def default_cache() -> AotCache:
+    """Process-wide cache (disk tier from ``CORRO_AOT_DIR`` when set).
+    Entry points take an explicit ``aot=`` cache and fall back here."""
+    global _default
+    if _default is None:
+        _default = AotCache()
+    return _default
+
+
+def reset_default_cache() -> None:
+    """Drop the process-wide cache (tests that need a cold slate)."""
+    global _default
+    _default = None
+
+
+# -- BENCHMARKS.md cold-vs-AOT-warm section (generated, not hand-edited) ----
+
+BEGIN_MARK = (
+    "<!-- aot:begin (generated by corrosion_tpu.sim.aot; do not hand-edit) -->"
+)
+END_MARK = "<!-- aot:end -->"
+
+
+def _bench_lines(path: str) -> List[dict]:
+    lines: List[dict] = []
+    with open(path) as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    lines.append(json.loads(raw))
+                except json.JSONDecodeError:
+                    pass
+    return lines
+
+
+def aot_markdown(cold_lines: List[dict], warm_lines: List[dict]) -> str:
+    """Cold-compile vs AOT-warm wall-clock table: one row per config
+    metric present in both bench files, keyed by metric name."""
+    cold_by = {ln.get("metric"): ln for ln in cold_lines if "metric" in ln}
+    out = [
+        BEGIN_MARK,
+        "",
+        "## AOT executables: cold compile vs warm artifact dir",
+        "",
+        "Same configs, same device: `cold` lines compiled fresh;",
+        "`aot-warm` lines ran with a primed artifact dir",
+        "(`bench.py --aot-dir`, corrosion_tpu/sim/aot.py), so compile_s",
+        "is the cost of deserializing the stored executable instead of",
+        "lowering + XLA-compiling it.  Rounds and flight sha256 are",
+        "asserted unchanged — the artifact replays the same program.",
+        "",
+        "| metric | cold compile | cold total | aot compile | aot total "
+        "| compile cut | artifact |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for ln in warm_lines:
+        m = ln.get("metric")
+        cold = cold_by.get(m)
+        if cold is None or ln.get("fleet") or "compile_s" not in ln:
+            continue
+        cc, wc = cold.get("compile_s", 0.0), ln.get("compile_s", 0.0)
+        cut = f"**{cc / wc:.0f}×**" if wc > 0 else "—"
+        size = ln.get("aot_artifact_bytes", 0)
+        out.append(
+            "| {m} | {cc:.2f} s | {ct:.2f} s | {wc:.3f} s | {wt:.2f} s "
+            "| {cut} | {sz:.1f} MB |".format(
+                m=str(m).replace("sim_", "").replace("_convergence_wall", ""),
+                cc=cc,
+                ct=cold.get("value", 0.0),
+                wc=wc,
+                wt=ln.get("value", 0.0),
+                cut=cut,
+                sz=size / 1e6,
+            )
+        )
+    out += ["", END_MARK]
+    return "\n".join(out)
+
+
+def update_benchmarks(cold_json: str, warm_json: str, md_path: str) -> None:
+    """Replace (or append) the marker-delimited AOT section — same
+    contract as the roofline / convergence / fleet sections."""
+    section = aot_markdown(_bench_lines(cold_json), _bench_lines(warm_json))
+    with open(md_path) as fh:
+        doc = fh.read()
+    if BEGIN_MARK in doc and END_MARK in doc:
+        head, rest = doc.split(BEGIN_MARK, 1)
+        _, tail = rest.split(END_MARK, 1)
+        doc = head + section + tail
+    else:
+        doc = doc.rstrip("\n") + "\n\n" + section + "\n"
+    with open(md_path, "w") as fh:
+        fh.write(doc)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="regenerate the BENCHMARKS.md cold-vs-AOT-warm section"
+    )
+    ap.add_argument("--cold", default="BENCH_r06.json",
+                    help="bench JSON with cold-compile lines")
+    ap.add_argument("--warm", default="BENCH_r10.json",
+                    help="bench JSON from a primed --aot-dir run")
+    ap.add_argument("--md", default="BENCHMARKS.md")
+    args = ap.parse_args()
+    update_benchmarks(args.cold, args.warm, args.md)
+    print(f"updated {args.md} from {args.cold} + {args.warm}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

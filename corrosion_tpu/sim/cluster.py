@@ -60,7 +60,6 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from functools import partial
 from typing import List, NamedTuple, Optional, Tuple
 
 import jax
@@ -99,6 +98,8 @@ class SimResult:
     coverage: List[float] = field(default_factory=list)
     state: Optional[SimState] = None  # final state if requested
     flight: Optional[object] = None  # FlightRecord when run(record=True)
+    aot: Optional[str] = None  # "compile" | "disk" | "memory" (sim/aot.py)
+    aot_bytes: int = 0  # serialized artifact size on disk
 
 
 class Knobs(NamedTuple):
@@ -222,30 +223,83 @@ class _StepEnv:
         )
 
 
-def init_state(p: SimParams) -> SimState:
+def init_state(p: SimParams, batch: Optional[int] = None) -> SimState:
+    """Round-0 state; seed-independent (zeros + ALIVE fill), so one
+    broadcastable build serves every fleet lane.  ``batch=B`` prepends a
+    scenario axis to every plane and vectorizes the round counter — the
+    fleet runner builds it OUTSIDE its compiled program so the whole
+    batched carry is a donatable input buffer."""
     S = max(1, p.nseq_max)
+    lead = () if batch is None else (batch,)
+    n_views = p.n_nodes if (p.swim and p.swim_per_node_views) else 2
     if p.packed:
         # uint32 word planes (sim/pack.py): up to 32 changesets per cov
         # word, 16 budget counters per word — the 3-5× live-state cut
         # that buys 1M→4M single-chip headroom (sim/profile.py)
-        cov = jnp.zeros((p.n_nodes, pack.cov_words(p)), dtype=jnp.uint32)
-        budget = jnp.zeros((p.n_nodes, pack.budget_words(p)), dtype=jnp.uint32)
-        n_views = p.n_nodes if (p.swim and p.swim_per_node_views) else 2
-        status = jnp.full((n_views, p.n_nodes), ALIVE, dtype=jnp.int8)
-        since = jnp.zeros((n_views, p.n_nodes), dtype=jnp.int32)
-        return cov, budget, status, since, jnp.int32(0)
-    cov = jnp.zeros((p.n_nodes, p.n_changes), dtype=jnp.uint8)
-    # per-CHUNK retransmission budgets: the runtime re-sends each pending
-    # payload (= one chunk) on its own send_count (broadcast/mod.rs:
-    # 747-773); a shared per-changeset budget measurably over-disseminates
-    # (chunked-payload fidelity experiment, tests/test_sim_vs_harness.py)
-    budget = jnp.zeros((p.n_nodes, p.n_changes, S), dtype=jnp.int8)
+        cov = jnp.zeros(lead + (p.n_nodes, pack.cov_words(p)), dtype=jnp.uint32)
+        budget = jnp.zeros(
+            lead + (p.n_nodes, pack.budget_words(p)), dtype=jnp.uint32
+        )
+    else:
+        cov = jnp.zeros(lead + (p.n_nodes, p.n_changes), dtype=jnp.uint8)
+        # per-CHUNK retransmission budgets: the runtime re-sends each pending
+        # payload (= one chunk) on its own send_count (broadcast/mod.rs:
+        # 747-773); a shared per-changeset budget measurably over-disseminates
+        # (chunked-payload fidelity experiment, tests/test_sim_vs_harness.py)
+        budget = jnp.zeros(lead + (p.n_nodes, p.n_changes, S), dtype=jnp.int8)
     # membership views: [2, N] per-side consensus, or [N, N] per-node
     # (model.py swim_per_node_views — viewer-major rows)
-    n_views = p.n_nodes if (p.swim and p.swim_per_node_views) else 2
-    status = jnp.full((n_views, p.n_nodes), ALIVE, dtype=jnp.int8)
-    since = jnp.zeros((n_views, p.n_nodes), dtype=jnp.int32)
-    return cov, budget, status, since, jnp.int32(0)
+    status = jnp.full(lead + (n_views, p.n_nodes), ALIVE, dtype=jnp.int8)
+    since = jnp.zeros(lead + (n_views, p.n_nodes), dtype=jnp.int32)
+    r = jnp.int32(0) if batch is None else jnp.zeros(lead, dtype=jnp.int32)
+    return cov, budget, status, since, r
+
+
+def save_state(state: SimState, path: str) -> None:
+    """Checkpoint a scan carry to npz (``--checkpoint``).  The round
+    counter rides the carry, so the snapshot is self-describing: resume
+    needs no side-channel round bookkeeping."""
+    import numpy as np
+
+    cov, budget, status, since, r = state
+    np.savez(
+        path,
+        cov=np.asarray(cov),
+        budget=np.asarray(budget),
+        status=np.asarray(status),
+        since=np.asarray(since),
+        round=np.asarray(r),
+    )
+
+
+def load_state(path: str) -> SimState:
+    """Load a :func:`save_state` snapshot as fresh device arrays (safe to
+    donate — nothing else aliases them)."""
+    import numpy as np
+
+    with np.load(path) as z:
+        return (
+            jnp.asarray(z["cov"]),
+            jnp.asarray(z["budget"]),
+            jnp.asarray(z["status"]),
+            jnp.asarray(z["since"]),
+            jnp.asarray(z["round"]),
+        )
+
+
+def _check_state_matches(p: SimParams, state: SimState) -> None:
+    """A resumed snapshot must have exactly the shapes/dtypes ``p``
+    implies — a mismatch means the npz came from different params and
+    would either fail to compile or silently simulate a different
+    cluster."""
+    want = jax.eval_shape(lambda: init_state(p))
+    for i, (w, g) in enumerate(zip(want, state)):
+        if tuple(w.shape) != tuple(jnp.shape(g)) or w.dtype != g.dtype:
+            raise ValueError(
+                f"initial_state leaf {i} is {jnp.shape(g)}/{g.dtype}, "
+                f"but params imply {tuple(w.shape)}/{w.dtype} — "
+                "snapshot from different SimParams?"
+            )
 
 
 def complete_mask(state_cov: jnp.ndarray, p: SimParams) -> jnp.ndarray:
@@ -1212,8 +1266,10 @@ def full_plane_for(p: SimParams, seed) -> jnp.ndarray:
     return full
 
 
-def _run_loop(p: SimParams, state: SimState, chaos=None) -> SimState:
-    step = make_step(p, chaos=chaos)
+def _run_loop(
+    p: SimParams, state: SimState, chaos=None, chaos_arrays=None
+) -> SimState:
+    step = make_step(p, chaos=chaos, chaos_arrays=chaos_arrays)
     full = _full_plane(p)
 
     def cond(state):
@@ -1223,6 +1279,35 @@ def _run_loop(p: SimParams, state: SimState, chaos=None) -> SimState:
         return jnp.logical_and(~done, r < p.max_rounds)
 
     return lax.while_loop(cond, lambda s: step(s), state)
+
+
+def chaos_operands(p: SimParams, chaos) -> dict:
+    """One schedule's fault planes as the ``chaos_arrays`` operand dict
+    of :func:`make_step` (the solo twin of ``LoweredChaos.stack``).
+
+    Passing the planes as traced operands instead of closure constants
+    means ONE compiled executable serves every schedule of the same
+    (n_nodes, horizon, fault-kind) signature — which is why the AOT key
+    (sim/aot.py) includes the chaos horizon and plane shapes but never
+    the schedule's contents.  Zero-plane semantics match ``stack``: the
+    ``die``/``drop_ppm`` keys exist only when the schedule carries that
+    fault, so a fault-free schedule compiles none of that machinery."""
+    chaos.require_sim_lowerable()
+    assert chaos.n_nodes == p.n_nodes, (
+        "chaos schedule sized for another cluster"
+    )
+    planes = {
+        "part_side": jnp.asarray(chaos.part_side),
+        "part_active": jnp.asarray(chaos.part_active),
+        "dead": jnp.asarray(chaos.dead),
+        "restart": jnp.asarray(chaos.restart),
+        "seed": jnp.uint32(chaos.schedule.seed & 0xFFFFFFFF),
+    }
+    if chaos.any_die():
+        planes["die"] = jnp.asarray(chaos.die)
+    if chaos.drop_ppm is not None:
+        planes["drop_ppm"] = jnp.asarray(chaos.drop_ppm)
+    return planes
 
 
 def node_sharding(mesh: Mesh, axis: str = "nodes"):
@@ -1277,6 +1362,9 @@ def run(
     return_state: bool = False,
     chaos=None,
     record: bool = False,
+    initial_state: Optional[SimState] = None,
+    start_round: int = 0,
+    aot=None,
 ) -> SimResult:
     """Run to convergence (or max_rounds); returns timing split into
     compile and execute so the <60 s north star is measured on execute+
@@ -1291,7 +1379,24 @@ def run(
     ``SimResult.flight`` carries the per-round series.  Recording is
     non-perturbing — bit-identical rounds and final state to
     ``record=False`` (tests/test_sim_flight.py) — but scans all
-    ``p.max_rounds`` rounds, so it costs wall-clock past convergence."""
+    ``p.max_rounds`` rounds, so it costs wall-clock past convergence.
+
+    Resume: ``initial_state`` (a :func:`save_state` snapshot or a
+    previous ``SimResult.state``) continues a run mid-soak; the round
+    counter rides the carry, so every (seed, tag, round) RNG draw and
+    chaos round-gather lines up bit-identically with the uninterrupted
+    run (tests/test_sim_aot.py).  ``start_round`` starts a FRESH state's
+    counter past zero (rarely useful alone; the snapshot path ignores it
+    because the snapshot already carries its round).  The state carry is
+    **donated** to the executable — a caller-provided ``initial_state``
+    is consumed by the call; snapshot to npz first if it must survive.
+
+    ``aot`` is a sim/aot.py ``AotCache`` (default: the process-wide
+    cache, plus the ``CORRO_AOT_DIR`` disk tier when set).  Chaos planes
+    enter the executable as runtime operands, so one cached executable
+    serves every schedule with the same shape/horizon/fault-kind
+    signature.  Mesh runs skip the disk tier: a serialized GSPMD
+    executable bakes in this host's device assignment."""
     if record:
         from . import flight
 
@@ -1299,13 +1404,35 @@ def run(
             "flight recording is a single-host analysis mode; run the "
             "sharded production loop with record=False"
         )
-        return flight.record_run(p, chaos=chaos, return_state=return_state)
+        return flight.record_run(
+            p,
+            chaos=chaos,
+            return_state=return_state,
+            initial_state=initial_state,
+            start_round=start_round,
+            aot=aot,
+        )
+    from . import aot as aotmod
+
+    cache = aotmod.default_cache() if aot is None else aot
     if chaos is not None:
         assert chaos.horizon >= p.max_rounds, (
             "lower(sched, horizon=p.max_rounds) so round gathers stay "
             "in bounds (XLA clamps out-of-range indices silently)"
         )
-    state = init_state(p)
+    if initial_state is not None:
+        state = tuple(jnp.asarray(x) for x in initial_state)
+        _check_state_matches(p, state)
+        start_round = int(state[-1])
+    else:
+        state = init_state(p)
+        if start_round:
+            state = state[:-1] + (jnp.int32(start_round),)
+    planes = None if chaos is None else chaos_operands(p, chaos)
+    statics = (
+        aotmod.params_key(p),
+        ("chaos_horizon", None if chaos is None else chaos.horizon),
+    )
     if mesh is not None:
         shardings = state_shardings(
             p, mesh, node_axis=mesh_axis, change_axis=change_axis
@@ -1314,17 +1441,51 @@ def run(
             x if s is None else jax.device_put(x, s)
             for x, s in zip(state, shardings)
         )
-        fn = jax.jit(
-            partial(_run_loop, p, chaos=chaos),
-            in_shardings=(shardings,),
-            out_shardings=shardings,
+        mesh_statics = statics + (
+            ("mesh", tuple(mesh.shape.items()), mesh_axis, change_axis),
         )
+
+        def build():
+            if planes is None:
+                return jax.jit(
+                    lambda s: _run_loop(p, s),
+                    in_shardings=(shardings,),
+                    out_shardings=shardings,
+                    donate_argnums=0,
+                )
+            return jax.jit(
+                lambda s, ch: _run_loop(p, s, chaos_arrays=ch),
+                in_shardings=(shardings, None),
+                out_shardings=shardings,
+                donate_argnums=0,
+            )
+
+        args = (state,) if planes is None else (state, planes)
+        t0 = time.perf_counter()
+        # persist=False: the serialized form of a sharded executable
+        # bakes in a device assignment; keep mesh programs memory-only
+        compiled, info = cache.get_or_compile(
+            "cluster.run.mesh", mesh_statics, build, args, persist=False
+        )
+        t1 = time.perf_counter()
+        out = jax.block_until_ready(compiled(*args))
     else:
-        fn = jax.jit(partial(_run_loop, p, chaos=chaos))
-    t0 = time.perf_counter()
-    compiled = fn.lower(state).compile()
-    t1 = time.perf_counter()
-    out = jax.block_until_ready(compiled(state))
+
+        def build():
+            if planes is None:
+                return jax.jit(lambda s: _run_loop(p, s), donate_argnums=0)
+            return jax.jit(
+                lambda s, ch: _run_loop(p, s, chaos_arrays=ch),
+                donate_argnums=0,
+            )
+
+        args = (state,) if planes is None else (state, planes)
+        t0 = time.perf_counter()
+        compiled, info = cache.get_or_compile(
+            "cluster.run", statics, build, args
+        )
+        t1 = time.perf_counter()
+        out = jax.block_until_ready(compiled(*args))
     # scalar fetch INSIDE the timed region: on the axon TPU plugin
     # block_until_ready can return before execution finishes, which made
     # execute_s read as milliseconds while the next call absorbed the
@@ -1339,6 +1500,8 @@ def run(
         wall_s=t2 - t1,
         compile_s=t1 - t0,
         state=tuple(out) if return_state else None,
+        aot=info.source,
+        aot_bytes=info.artifact_bytes,
     )
 
 
@@ -1376,7 +1539,10 @@ def run_trace(
 
     t0 = time.perf_counter()
     out, counts = jax.block_until_ready(
-        jax.jit(lambda s: lax.scan(body, s, None, length=n_rounds))(init_state(p))
+        jax.jit(
+            lambda s: lax.scan(body, s, None, length=n_rounds),
+            donate_argnums=0,
+        )(init_state(p))
     )
     int(out[-1])  # scalar fetch: see the axon note in run()
     t1 = time.perf_counter()

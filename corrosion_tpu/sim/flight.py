@@ -68,10 +68,16 @@ class FlightRecord:
     """One recorded run: run identity + per-round int series.
 
     ``series`` maps every :data:`TELEMETRY_FIELDS` name to a list of
-    ``rounds`` ints (the scan's post-convergence zero rows are
-    truncated).  ``max_rounds`` is the scanned horizon the record was
-    bounded by; ``rounds`` ≤ ``max_rounds`` is the convergence round
-    (== SimResult.rounds, bit-identical to the while_loop)."""
+    ``rounds - start_round`` ints (the scan's post-convergence zero rows
+    are truncated).  ``max_rounds`` is the scanned horizon the record
+    was bounded by; ``rounds`` ≤ ``max_rounds`` is the convergence round
+    (== SimResult.rounds, bit-identical to the while_loop).
+
+    ``start_round`` > 0 marks a resumed segment (``record_run`` with
+    ``initial_state``): rounds and max_rounds stay absolute, the series
+    rows cover rounds ``start_round+1 .. rounds``, and
+    :func:`concat_records` splices contiguous segments back into the
+    uninterrupted record."""
 
     n_nodes: int
     n_changes: int
@@ -82,7 +88,12 @@ class FlightRecord:
     rounds: int
     converged: bool
     schedule_hash: Optional[str] = None
+    start_round: int = 0
     series: Dict[str, List[int]] = field(default_factory=dict)
+
+    @property
+    def n_rows(self) -> int:
+        return self.rounds - self.start_round
 
     def coverage(self) -> List[float]:
         """Per-round complete-pair fraction in [0, 1]."""
@@ -95,6 +106,9 @@ def record_run(
     chaos=None,
     n_rounds: Optional[int] = None,
     return_state: bool = False,
+    initial_state=None,
+    start_round: int = 0,
+    aot=None,
 ) -> SimResult:
     """Run ``p`` under the flight recorder; ``SimResult.flight`` carries
     the :class:`FlightRecord`.
@@ -105,26 +119,73 @@ def record_run(
     final carry — round counter included — is bit-identical to the
     ``record=False`` exit.  ``n_rounds`` bounds the scan (default
     ``p.max_rounds``; bench.py passes the measured convergence round so
-    large configs don't idle to the horizon)."""
+    large configs don't idle to the horizon).
+
+    Resume: ``initial_state`` continues a soak from a snapshot; the
+    scan covers rounds ``start_round+1 .. n_rounds`` (the snapshot's
+    own round counter sets ``start_round``) and the record's series
+    holds only this segment's rows — :func:`concat_records` splices
+    segments back into the uninterrupted record, bit-identically
+    (tests/test_sim_aot.py).  The state carry is donated; a
+    caller-provided ``initial_state`` is consumed by the call.
+
+    ``aot`` is a sim/aot.py ``AotCache`` (default: the process-wide
+    cache): the scan executable is cached per (params, scan length,
+    chaos plane signature) and serialized to the cache's disk tier, so
+    repeat recordings skip lowering entirely."""
+    from . import aot as aotmod
+
+    cache = aotmod.default_cache() if aot is None else aot
     n_rounds = p.max_rounds if n_rounds is None else n_rounds
     if chaos is not None:
         assert chaos.horizon >= n_rounds, (
             "lower(sched, horizon=n_rounds) so round gathers stay in "
             "bounds (XLA clamps out-of-range indices silently)"
         )
-    step = cluster.make_step(p, chaos=chaos, telemetry=True)
+    if initial_state is not None:
+        state0 = tuple(jnp.asarray(x) for x in initial_state)
+        cluster._check_state_matches(p, state0)
+        start_round = int(state0[-1])
+    else:
+        state0 = cluster.init_state(p)
+        if start_round:
+            state0 = state0[:-1] + (jnp.int32(start_round),)
+    length = n_rounds - start_round
+    assert length > 0, (
+        f"resume at round {start_round} past the horizon {n_rounds}"
+    )
+    planes = None if chaos is None else cluster.chaos_operands(p, chaos)
     full = cluster._full_plane(p)
     zeros = {f: jnp.int32(0) for f in TELEMETRY_FIELDS}
 
-    def body(state, _):
-        done = (state[0] == full[None, :]).all()
-        return lax.cond(done, lambda s: (s, zeros), step, state)
+    def build():
+        def scan_fn(state, ch=None):
+            step = cluster.make_step(
+                p, telemetry=True, chaos_arrays=ch
+            )
 
+            def body(s, _):
+                done = (s[0] == full[None, :]).all()
+                return lax.cond(done, lambda x: (x, zeros), step, s)
+
+            return lax.scan(body, state, None, length=length)
+
+        if planes is None:
+            return jax.jit(lambda s: scan_fn(s), donate_argnums=0)
+        return jax.jit(lambda s, ch: scan_fn(s, ch), donate_argnums=0)
+
+    statics = (
+        aotmod.params_key(p),
+        ("scan_length", length),
+        ("chaos_horizon", None if chaos is None else chaos.horizon),
+    )
+    args = (state0,) if planes is None else (state0, planes)
     t0 = time.perf_counter()
-    fn = jax.jit(lambda s: lax.scan(body, s, None, length=n_rounds))
-    compiled = fn.lower(cluster.init_state(p)).compile()
+    compiled, info = cache.get_or_compile(
+        "flight.record_run", statics, build, args
+    )
     t1 = time.perf_counter()
-    out, tel = jax.block_until_ready(compiled(cluster.init_state(p)))
+    out, tel = jax.block_until_ready(compiled(*args))
     rounds_scanned = int(out[-1])  # scalar fetch: see the axon note in run()
     t2 = time.perf_counter()
     converged = bool((out[0] == full[None, :]).all())
@@ -135,9 +196,9 @@ def record_run(
     rounds = rounds_scanned
     for i, cp in enumerate(series["complete_pairs"]):
         if cp == total:
-            rounds = i + 1
+            rounds = start_round + i + 1
             break
-    series = {f: v[:rounds] for f, v in series.items()}
+    series = {f: v[: rounds - start_round] for f, v in series.items()}
     rec = FlightRecord(
         n_nodes=p.n_nodes,
         n_changes=p.n_changes,
@@ -150,6 +211,7 @@ def record_run(
         schedule_hash=(
             chaos.schedule.schedule_hash() if chaos is not None else None
         ),
+        start_round=start_round,
         series=series,
     )
     return SimResult(
@@ -160,6 +222,44 @@ def record_run(
         coverage=rec.coverage(),
         state=tuple(out) if return_state else None,
         flight=rec,
+        aot=info.source,
+        aot_bytes=info.artifact_bytes,
+    )
+
+
+def concat_records(a: FlightRecord, b: FlightRecord) -> FlightRecord:
+    """Splice a resumed segment ``b`` onto its predecessor ``a``.
+
+    The segments must describe the same run (identity fields equal) and
+    be contiguous: ``b.start_round`` must equal ``a.rounds`` — the
+    snapshot the resume started from IS the state ``a`` finished with.
+    The result is bit-identical to recording the whole span in one scan
+    (tests/test_sim_aot.py asserts this on all five BASELINE configs)."""
+    for f in ("n_nodes", "n_changes", "nseq_max", "seed", "packed",
+              "schedule_hash"):
+        assert getattr(a, f) == getattr(b, f), (
+            f"concat across different runs: {f} differs"
+        )
+    assert not a.converged, "nothing to splice: first segment converged"
+    assert b.start_round == a.rounds, (
+        f"segments not contiguous: first ends at round {a.rounds}, "
+        f"second resumes at {b.start_round}"
+    )
+    return FlightRecord(
+        n_nodes=a.n_nodes,
+        n_changes=a.n_changes,
+        nseq_max=a.nseq_max,
+        seed=a.seed,
+        packed=a.packed,
+        max_rounds=b.max_rounds,
+        rounds=b.rounds,
+        converged=b.converged,
+        schedule_hash=a.schedule_hash,
+        start_round=a.start_round,
+        series={
+            f: list(a.series[f]) + list(b.series[f])
+            for f in TELEMETRY_FIELDS
+        },
     )
 
 
@@ -172,7 +272,10 @@ def _dumps(obj: dict) -> str:
 
 def to_ndjson(rec: FlightRecord) -> str:
     """Canonical byte-deterministic artifact: one sorted-key header line,
-    then one object per recorded round."""
+    then one object per recorded round.  ``start_round`` appears in the
+    header only for resumed segments (non-zero), so the bytes — and
+    :func:`record_hash` — of every whole-run record are unchanged from
+    before segments existed."""
     head = {
         "flight": 1,
         "n_nodes": rec.n_nodes,
@@ -186,9 +289,11 @@ def to_ndjson(rec: FlightRecord) -> str:
         "schedule_hash": rec.schedule_hash,
         "fields": list(TELEMETRY_FIELDS),
     }
+    if rec.start_round:
+        head["start_round"] = rec.start_round
     lines = [_dumps(head)]
-    for i in range(rec.rounds):
-        row = {"round": i}
+    for i in range(rec.n_rows):
+        row = {"round": rec.start_round + i}
         for f in TELEMETRY_FIELDS:
             row[f] = rec.series[f][i]
         lines.append(_dumps(row))
@@ -215,6 +320,7 @@ def from_ndjson(text: str) -> FlightRecord:
         rounds=head["rounds"],
         converged=head["converged"],
         schedule_hash=head.get("schedule_hash"),
+        start_round=head.get("start_round", 0),
         series=series,
     )
 

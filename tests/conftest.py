@@ -29,6 +29,16 @@ os.environ.setdefault(
 )
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
+# Shared AOT artifact dir (sim/aot.py): the hot entry points
+# (cluster.run / flight.record_run / fleet.run_fleet) serialize their
+# compiled executables here, so the many tests that re-run the same
+# shape buckets skip lower+compile after the first module that pays it
+# — and repeat test runs start warm.  Same env-var route as the XLA
+# cache so CLI subprocesses inherit it.
+os.environ.setdefault(
+    "CORRO_AOT_DIR", os.path.join(_repo, ".aot_test_cache")
+)
+
 # The environment's TPU integration overrides jax_platforms at import time
 # (ignoring the env var), so pin it back to cpu right after import.
 import jax  # noqa: E402

@@ -89,6 +89,21 @@ jax.jit(step)
     assert trace_rules(good) == set()
 
 
+def test_gl101_optional_annotated_param_not_flagged():
+    # `Optional[int]` is still a host scalar (None-or-int decided at
+    # trace time) — sim/cluster.py init_state's `batch` rides this.
+    good = """
+import jax
+def build(state):
+    def init(p, batch: Optional[int] = None):
+        lead = () if batch is None else (batch,)
+        return state[0].reshape(lead + state[0].shape)
+    return init(0) + init(0, batch=2).sum()
+jax.jit(build)
+"""
+    assert trace_rules(good) == set()
+
+
 # -- GL102: impure calls in pure regions -------------------------------------
 
 def test_gl102_time_and_nprandom():
@@ -195,6 +210,56 @@ def step(x):
 jax.jit(step)
 """
     assert trace_rules(good) == set()
+
+
+# -- GL401: jit without buffer donation ---------------------------------------
+
+def donation_rules(src):
+    from corrosion_tpu.analysis import donation
+
+    return {f.rule for f in donation.check_source("fix.py", src)}
+
+
+def test_gl401_jit_without_donation():
+    bad = """
+import jax
+def run(p, state):
+    step = jax.jit(lambda s: transition(p, s))
+    return step(state)
+"""
+    assert "GL401" in donation_rules(bad)
+
+
+def test_gl401_donated_jit_not_flagged():
+    good = """
+import jax
+def run(p, state):
+    step = jax.jit(lambda s: transition(p, s), donate_argnums=0)
+    keyed = jax.jit(lambda s: transition(p, s), donate_argnames="s")
+    return keyed(step(state))
+"""
+    assert donation_rules(good) == set()
+
+
+def test_gl401_scoped_to_device_program_dirs():
+    """The donation pass runs over sim/, crdt/ and fleet/ — a jit in an
+    out-of-scope dir (say a doc example under agent/) is not the pass's
+    business (DONATION_DIRS pins the scope)."""
+    from corrosion_tpu.analysis import DONATION_DIRS
+
+    assert set(DONATION_DIRS) == {"sim", "crdt", "fleet"}
+
+
+def test_gl401_suppressible_with_reason():
+    src = """
+import jax
+probe = jax.jit(lambda a: a + 1)  # graftlint: disable=GL401 (bandwidth probe re-times the same buffer across reps)
+"""
+    from corrosion_tpu.analysis import donation
+
+    findings = donation.check_source("fix.py", src)
+    sups, meta = scan_suppressions("fix.py", src)
+    assert not apply_suppressions(findings, sups) and not meta
 
 
 # -- GL201: await under lock -------------------------------------------------

@@ -1,0 +1,368 @@
+"""AOT executable cache + checkpoint/resume (sim/aot.py, ISSUE PR 9).
+
+Layers under test, cheapest first:
+
+1. resume bit-identity — run-to-round-r + snapshot + resume must land on
+   EXACTLY the uninterrupted run's round count and final state, on all
+   five BASELINE configs (reduced scale), packed+framed, and under a
+   combined chaos schedule (the round counter rides the carry, so every
+   (seed, tag, round) RNG draw and chaos round-gather lines up);
+2. flight segments — a recording split at round r and spliced back with
+   ``concat_records`` equals the uninterrupted record byte-for-byte in
+   NDJSON, and the segment header round-trips its ``start_round``;
+3. artifact tiers — compile → memory → disk verdicts in order, disk
+   round-trip replays identical results in a fresh interpreter (the
+   shipped-artifact-dir client), corrupt or format-bumped artifacts
+   recompile (never crash) and heal the file;
+4. fleet — ``run_fleet`` reuses one executable across repeat sweeps
+   (the tuner's rungs ride exactly this path).
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim import aot, cluster, flight, model
+from corrosion_tpu.sim.model import SimParams
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _state_digest(state) -> str:
+    h = hashlib.sha256()
+    for leaf in state:
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def _run_in_fresh_process(snippet: str, cache_dir: str) -> dict:
+    """A fresh interpreter is the honest disk-tier client.  In-process,
+    XLA:CPU can refuse to deserialize an executable whose symbols were
+    already JIT-registered by an earlier compile of this same test run
+    ("Symbols not found") and the cache then quietly falls back to a
+    recompile — exactly the right behavior for a cache, and exactly the
+    wrong setup for asserting ``source == "disk"``.  It also proves the
+    persisted artifact was a genuinely fresh compile (AotCache bypasses
+    jax's persistent compilation cache for those): an executable served
+    from that cache serializes incomplete and only a process that never
+    compiled it can tell."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("CORRO_AOT_DIR", None)  # the snippet names its dir explicitly
+    out = subprocess.run(
+        [sys.executable, "-c", snippet, cache_dir],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    if out.stderr:
+        print(out.stderr, file=sys.stderr)  # surfaced by pytest on failure
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-2000:])
+    return json.loads(out.stdout.splitlines()[-1])
+
+
+_DISK_CLIENT = """
+import hashlib, json, sys
+import numpy as np
+from corrosion_tpu.sim import aot, cluster, model
+p = model.config1_ring3(seed=7)
+c = aot.AotCache(cache_dir=sys.argv[1])
+r = cluster.run(p, aot=c, return_state=True)
+h = hashlib.sha256()
+for leaf in r.state:
+    h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+print(json.dumps({"aot": r.aot, "rounds": r.rounds, "hits": c.hits,
+                  "misses": c.misses, "digest": h.hexdigest()}))
+"""
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _drop_compiled_programs():
+    # same hygiene as tests/test_sim_flight.py: drop this module's
+    # compiled programs so later timing-sensitive tests start clean
+    yield
+    import jax
+
+    jax.clear_caches()
+
+
+def small_configs():
+    # the BASELINE matrix at test scale (same shapes as
+    # tests/test_sim_flight.py), plus packed+framed hot-path variants —
+    # resume must be bit-identical on the word planes too
+    return {
+        "config1_ring3": model.config1_ring3(seed=7),
+        "config2_er": model.config2_er1k(seed=7).with_(
+            n_nodes=120, n_changes=16, max_rounds=128
+        ),
+        "config3_powerlaw": model.config3_powerlaw10k(seed=7).with_(
+            n_nodes=150, n_changes=16, write_rounds=4, max_rounds=256
+        ),
+        "config4_churn": model.config4_churn100k(seed=7).with_(
+            n_nodes=100, n_changes=16, write_rounds=4,
+            churn_rounds=6, max_rounds=256,
+        ),
+        "config5_partition": model.config5_partition100k(seed=7).with_(
+            n_nodes=100, n_changes=16, write_rounds=4,
+            partition_rounds=10, max_rounds=256,
+        ),
+        "config3_packed_framed": model.config3_powerlaw10k(seed=7).with_(
+            n_nodes=150, n_changes=16, write_rounds=4, max_rounds=256,
+            packed=True, framed=True,
+        ),
+        "config4_packed": model.config4_churn100k(seed=7).with_(
+            n_nodes=100, n_changes=16, write_rounds=4,
+            churn_rounds=6, max_rounds=256, packed=True,
+        ),
+    }
+
+
+def _states_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, b)
+    )
+
+
+# -- 1: resume bit-identity --------------------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(small_configs()))
+def test_resume_bit_identity(name):
+    p = small_configs()[name]
+    full = cluster.run(p, return_state=True)
+    mid = max(1, full.rounds // 2)
+    part = cluster.run(p.with_(max_rounds=mid), return_state=True)
+    assert part.rounds == mid and not part.converged
+    res = cluster.run(p, initial_state=part.state, return_state=True)
+    assert res.rounds == full.rounds and res.converged == full.converged
+    assert _states_equal(res.state, full.state)
+
+
+def _combined_chaos():
+    from corrosion_tpu.chaos import GenParams, generate
+    from corrosion_tpu.chaos.lower import lower
+
+    gp = GenParams(
+        n_nodes=40, n_rounds=48, seed=3,
+        partition_frac_ppm=300_000, partition_rounds=5,
+        crash_ppm=60_000, crash_rounds=2, crash_down_rounds=3,
+        drop_ppm=80_000, drop_rounds=6,
+    )
+    p = SimParams(
+        n_nodes=40, n_changes=8, fanout=3, max_transmissions=2,
+        sync_interval=3, write_rounds=1, max_rounds=48,
+        swim=True, swim_suspicion=True, fanout_per_change=True, seed=3,
+    )
+    return p, lower(generate(gp), horizon=p.max_rounds)
+
+
+def test_resume_bit_identity_under_chaos():
+    """Chaos round-gathers index the ABSOLUTE round (the resumed carry's
+    counter), so a snapshot taken mid-partition replays the rest of the
+    schedule exactly where the uninterrupted run would."""
+    p, lw = _combined_chaos()
+    full = cluster.run(p, chaos=lw, return_state=True)
+    mid = max(1, full.rounds // 2)
+    part = cluster.run(p.with_(max_rounds=mid), chaos=lw, return_state=True)
+    res = cluster.run(p, chaos=lw, initial_state=part.state, return_state=True)
+    assert res.rounds == full.rounds and res.converged == full.converged
+    assert _states_equal(res.state, full.state)
+
+
+def test_save_load_state_roundtrip(tmp_path):
+    """The npz checkpoint path (``--checkpoint`` / ``--resume``): saved
+    carry → fresh arrays → resume, still bit-identical.  load_state must
+    return freshly allocated buffers — the resumed executable donates
+    its carry, so aliasing the npz mmap would be a use-after-free."""
+    p = small_configs()["config1_ring3"]
+    full = cluster.run(p, return_state=True)
+    mid = max(1, full.rounds // 2)
+    part = cluster.run(p.with_(max_rounds=mid), return_state=True)
+    ckpt = str(tmp_path / "soak.npz")
+    cluster.save_state(part.state, ckpt)
+    loaded = cluster.load_state(ckpt)
+    assert int(loaded[-1]) == mid  # the snapshot is self-describing
+    res = cluster.run(p, initial_state=loaded, return_state=True)
+    assert res.rounds == full.rounds
+    assert _states_equal(res.state, full.state)
+
+
+def test_initial_state_shape_mismatch_raises():
+    p = small_configs()["config1_ring3"]
+    part = cluster.run(p.with_(max_rounds=2), return_state=True)
+    with pytest.raises(ValueError):
+        cluster.run(p.with_(n_nodes=p.n_nodes + 8), initial_state=part.state)
+
+
+# -- 2: flight recorder segments --------------------------------------------
+
+
+def test_flight_segments_splice_bit_identical():
+    p = small_configs()["config3_powerlaw"]
+    full = flight.record_run(p)
+    mid = max(1, full.rounds // 2)
+    seg1 = flight.record_run(p, n_rounds=mid, return_state=True)
+    assert not seg1.converged
+    seg2 = flight.record_run(p, initial_state=seg1.state, return_state=True)
+    assert seg2.flight.start_round == mid
+    rec = flight.concat_records(seg1.flight, seg2.flight)
+    assert flight.to_ndjson(rec) == flight.to_ndjson(full.flight)
+
+
+def test_flight_segment_header_roundtrip():
+    p = small_configs()["config1_ring3"]
+    mid = max(1, flight.record_run(p).rounds // 2)
+    seg1 = flight.record_run(p, n_rounds=mid, return_state=True)
+    assert not seg1.converged
+    seg2 = flight.record_run(p, initial_state=seg1.state)
+    ndj = flight.to_ndjson(seg2.flight)
+    back = flight.from_ndjson(ndj)
+    assert back.start_round == mid
+    assert flight.to_ndjson(back) == ndj
+    # an unsegmented record's header omits start_round entirely — the
+    # artifact digests of every pre-AOT recording stay stable
+    head = flight.to_ndjson(seg1.flight).splitlines()[0]
+    assert "start_round" not in head
+
+
+def test_concat_rejects_mismatched_segments():
+    p = small_configs()["config1_ring3"]
+    seg1 = flight.record_run(p, n_rounds=3, return_state=True)
+    other = flight.record_run(p.with_(seed=9), n_rounds=3)
+    with pytest.raises(AssertionError):
+        flight.concat_records(seg1.flight, other.flight)
+
+
+# -- 3: artifact tiers -------------------------------------------------------
+
+
+def test_aot_tiers_and_disk_roundtrip(tmp_path):
+    p = small_configs()["config1_ring3"]
+    c1 = aot.AotCache(cache_dir=str(tmp_path))
+    r1 = cluster.run(p, aot=c1, return_state=True)
+    assert r1.aot == "compile" and r1.aot_bytes > 0
+    arts = sorted(tmp_path.glob("*.aot"))
+    assert len(arts) == 1 and arts[0].stat().st_size == r1.aot_bytes
+
+    r2 = cluster.run(p, aot=c1)
+    assert r2.aot == "memory" and r2.rounds == r1.rounds
+
+    # the shipped-artifact-dir story, as ops runs it: a dedicated fresh
+    # process primes the dir, a second fresh process loads from disk and
+    # replays identical results
+    primed = tmp_path / "primed"
+    first = _run_in_fresh_process(_DISK_CLIENT, str(primed))
+    assert first["aot"] == "compile"
+    got = _run_in_fresh_process(_DISK_CLIENT, str(primed))
+    assert got["aot"] == "disk"
+    assert got["rounds"] == r1.rounds == first["rounds"]
+    assert got["digest"] == first["digest"] == _state_digest(r1.state)
+    assert got["hits"] == 1 and got["misses"] == 0
+
+
+def test_aot_corrupt_artifact_recompiles(tmp_path):
+    p = small_configs()["config1_ring3"]
+    c1 = aot.AotCache(cache_dir=str(tmp_path))
+    r1 = cluster.run(p, aot=c1)
+    assert r1.aot == "compile"
+    (art,) = tmp_path.glob("*.aot")
+    art.write_bytes(b"\x00not a pickle")
+
+    c2 = aot.AotCache(cache_dir=str(tmp_path))
+    r2 = cluster.run(p, aot=c2)  # must fall back, not crash
+    assert r2.aot == "compile" and r2.rounds == r1.rounds
+
+    # cross-process: a fresh interpreter hitting a corrupted artifact
+    # also falls back to a compile — and HEALS the file, so the next
+    # fresh process loads clean
+    (art,) = tmp_path.glob("*.aot")
+    art.write_bytes(b"\x00not a pickle")
+    healed = _run_in_fresh_process(_DISK_CLIENT, str(tmp_path))
+    assert healed["aot"] == "compile" and healed["rounds"] == r1.rounds
+    got = _run_in_fresh_process(_DISK_CLIENT, str(tmp_path))
+    assert got["aot"] == "disk" and got["rounds"] == r1.rounds
+
+
+def test_aot_format_bump_recompiles(tmp_path):
+    """An artifact written by a future/older AOT_FORMAT is rejected at
+    load (the header check), triggering recompile — a version bump never
+    deserializes blind."""
+    p = small_configs()["config1_ring3"]
+    c1 = aot.AotCache(cache_dir=str(tmp_path))
+    r1 = cluster.run(p, aot=c1)
+    (art,) = tmp_path.glob("*.aot")
+    doc = pickle.loads(art.read_bytes())
+    doc["format"] = aot.AOT_FORMAT + 1
+    art.write_bytes(pickle.dumps(doc))
+
+    c2 = aot.AotCache(cache_dir=str(tmp_path))
+    r2 = cluster.run(p, aot=c2)
+    assert r2.aot == "compile" and r2.rounds == r1.rounds
+
+
+def test_aot_key_separates_shape_buckets(tmp_path):
+    c = aot.AotCache(cache_dir=str(tmp_path))
+    p = small_configs()["config1_ring3"]
+    cluster.run(p, aot=c)
+    r2 = cluster.run(p.with_(n_nodes=p.n_nodes + 8), aot=c)
+    assert r2.aot == "compile"  # different shape bucket, different key
+    assert len(list(tmp_path.glob("*.aot"))) == 2
+
+
+def test_record_run_rides_the_cache(tmp_path):
+    p = small_configs()["config1_ring3"]
+    c = aot.AotCache(cache_dir=str(tmp_path))
+    r1 = flight.record_run(p, aot=c)
+    assert r1.aot == "compile"
+    r2 = flight.record_run(p, aot=c)
+    assert r2.aot == "memory"
+    assert flight.to_ndjson(r2.flight) == flight.to_ndjson(r1.flight)
+
+
+# -- 4: fleet ----------------------------------------------------------------
+
+
+def test_fleet_aot_reuse(tmp_path):
+    from corrosion_tpu.fleet import batch
+    from corrosion_tpu.fleet import run as fleetrun
+
+    p = small_configs()["config3_powerlaw"].with_(n_nodes=64, max_rounds=64)
+    scenarios = [
+        p.with_(fanout=fo, seed=7 + k) for fo in (2, 3) for k in range(2)
+    ]
+    p_static, sweep = batch.split(scenarios)
+    c = aot.AotCache(cache_dir=str(tmp_path))
+    r1 = fleetrun.run_fleet(p_static, sweep, aot=c)
+    assert r1.aot == "compile"
+    r2 = fleetrun.run_fleet(p_static, sweep, aot=c)
+    assert r2.aot == "memory"
+    assert np.array_equal(np.asarray(r1.rounds), np.asarray(r2.rounds))
+
+    # fleet disk round-trip, primed by a fresh process as ops would
+    primed = str(tmp_path / "primed")
+    first = _run_in_fresh_process(_FLEET_DISK_CLIENT, primed)
+    assert first["aot"] == "compile"
+    got = _run_in_fresh_process(_FLEET_DISK_CLIENT, primed)
+    assert got["aot"] == "disk"
+    assert got["rounds"] == first["rounds"]
+    assert got["rounds"] == [int(r) for r in np.asarray(r1.rounds)]
+
+
+_FLEET_DISK_CLIENT = """
+import json, sys
+import numpy as np
+from corrosion_tpu.fleet import batch
+from corrosion_tpu.fleet import run as fleetrun
+from corrosion_tpu.sim import aot, model
+p = model.config3_powerlaw10k(seed=7).with_(
+    n_nodes=64, n_changes=16, write_rounds=4, max_rounds=64)
+scenarios = [p.with_(fanout=fo, seed=7 + k) for fo in (2, 3) for k in range(2)]
+p_static, sweep = batch.split(scenarios)
+c = aot.AotCache(cache_dir=sys.argv[1])
+r = fleetrun.run_fleet(p_static, sweep, aot=c)
+print(json.dumps({"aot": r.aot,
+                  "rounds": [int(x) for x in np.asarray(r.rounds)]}))
+"""

@@ -372,7 +372,33 @@ def main() -> None:
         help="run the 64-scenario config-3-regime fleet sweep instead of "
         "the BASELINE configs (one compile; corrosion_tpu/fleet/)",
     )
+    ap.add_argument(
+        "--serve",
+        action="store_true",
+        help="run the serving-plane leg instead: replay the pinned "
+        "acceptance ledger into a live agent with 8 HTTP subscribers, "
+        "2 PG readers, and one artificially stalled subscriber "
+        "(corrosion_tpu/harness/loadgen.py); stamps matcher throughput, "
+        "stream lag p50/p99, and lagged/evicted/reconnected counts",
+    )
+    ap.add_argument(
+        "--serve-qps",
+        type=float,
+        default=0.0,
+        help="QPS multiplier for --serve write pacing (x200 writes/s; "
+        "<= 0 replays flat out)",
+    )
     args = ap.parse_args()
+
+    if args.serve:
+        # pure-CPU asyncio leg: no device, no compile cache — keep JAX out
+        from corrosion_tpu.harness.loadgen import run_serve_bench
+
+        t0 = time.perf_counter()
+        out = run_serve_bench(args.seed, args.serve_qps)
+        print(json.dumps(out), flush=True)
+        log(f"serve leg wall: {time.perf_counter()-t0:.2f}s")
+        return
 
     t_all = time.perf_counter()
     import os

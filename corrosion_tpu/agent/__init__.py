@@ -9,6 +9,7 @@ from .agent import (  # noqa: F401
     AgentConfig,
     ExecResult,
     TransactionOutcome,
+    execute_and_notify,
     make_broadcastable_changes,
 )
 from .bookkeeping import (  # noqa: F401

@@ -347,6 +347,30 @@ async def make_broadcastable_changes(
     )
 
 
+async def execute_and_notify(
+    agent: Agent,
+    statements: List[Tuple[str, Tuple]],
+    *,
+    subs=None,
+    broadcast_hook=None,
+) -> TransactionOutcome:
+    """One local write, fully fanned out: run ``statements`` in a tx,
+    then hand the resulting changesets to the broadcast layer and to the
+    subscription matchers — the exact choreography every serving front
+    end repeats (HTTP tx_handler, PG query paths, the loadgen replay).
+    Keeping it here means a front end can't fan out half-way (e.g.
+    notifying matchers but never broadcasting)."""
+    outcome = await make_broadcastable_changes(agent, statements)
+    if outcome.changesets:
+        if broadcast_hook is not None:
+            await broadcast_hook(outcome.changesets)
+        if subs is not None:
+            subs.match_changes(
+                [(c.actor_id, c.changeset) for c in outcome.changesets]
+            )
+    return outcome
+
+
 def _flush_tx(conn: sqlite3.Connection, actor: ActorId, version: int):
     conn.execute("BEGIN IMMEDIATE")
     try:

@@ -11,12 +11,12 @@ re-broadcast to keep the epidemic going.
 from __future__ import annotations
 
 import asyncio
-import contextlib
 import logging
 from collections import OrderedDict
 from typing import Callable, List, Optional, Tuple
 
 from ..types.broadcast import ChangeSource, ChangesetFull, ChangeV1
+from ..utils.aio import cancel_and_wait
 from .agent import Agent
 
 APPLY_QUEUE_LEN = 600  # ref: handlers.rs apply_queue_len default
@@ -57,10 +57,10 @@ class ChangeIngest:
         self._task = asyncio.create_task(self._run())
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            with contextlib.suppress(asyncio.CancelledError):
-                await self._task
+        # cancel_and_wait, not a bare cancel+await: the batching loop's
+        # wait_for(queue.get(), ...) can swallow a cancel that lands in
+        # the same tick a change arrives (GH-86296), hanging teardown
+        await cancel_and_wait(self._task)
         # drain in-flight apply jobs so their write transactions finish
         # cleanly before the pool closes
         if self._apply_tasks:

@@ -28,6 +28,7 @@ from ..types.broadcast import ChangeSource
 from ..types.config import Config, parse_addr
 from ..types.members import Members
 from ..types.schema import apply_schema
+from ..utils.aio import cancel_and_wait
 from ..utils.metrics import counter
 from .. import wire
 from .agent import Agent, AgentConfig
@@ -356,11 +357,9 @@ class Node:
         if self.swim is not None and not crash:
             self.swim.leave()
             await self._pump_swim()
-        for t in self._tasks:
-            t.cancel()
-        for t in self._tasks:
-            with contextlib.suppress(asyncio.CancelledError):
-                await t
+        # re-issuing cancel (utils/aio.py): a bare cancel+await can hang
+        # when a loop's wait_for swallows the one cancel (GH-86296)
+        await cancel_and_wait(*self._tasks)
         self._tasks.clear()
         if self.ingest is not None:
             await self.ingest.stop()

@@ -28,7 +28,7 @@ from typing import Any, Callable, Optional, Tuple
 
 from aiohttp import web
 
-from ..agent import Agent, make_broadcastable_changes
+from ..agent import Agent, execute_and_notify
 from ..types.change import jsonify_cell as _encode_cell
 from ..types.schema import SchemaError, apply_schema
 
@@ -94,12 +94,26 @@ class Api:
         # () -> list of member dicts; wired by the node runtime (a bare
         # Api over an Agent has no cluster view)
         self.members_provider = members_provider
+        # serving-plane chaos (chaos/runtime.py ServingChaos): hook takes
+        # the request and returns an HTTP status to inject, or None
+        self.fault_hook: Optional[Callable[[web.Request], Optional[int]]] = None
+
+    def set_fault_hook(
+        self, hook: Optional[Callable[[web.Request], Optional[int]]]
+    ) -> None:
+        """Install/remove the serving-plane fault hook (chaos http_5xx
+        injection consults it before every handler)."""
+        self.fault_hook = hook
 
     # -- app wiring -------------------------------------------------------
 
     def build_app(self) -> web.Application:
         app = web.Application(
-            middlewares=[self._shed_middleware, self._auth_middleware]
+            middlewares=[
+                self._fault_middleware,
+                self._shed_middleware,
+                self._auth_middleware,
+            ]
         )
         app.router.add_post("/v1/transactions", self.tx_handler)
         app.router.add_post("/v1/queries", self.query_handler)
@@ -111,6 +125,21 @@ class Api:
 
             SubsApi(self.subs).register(app)
         return app
+
+    @web.middleware
+    async def _fault_middleware(self, request: web.Request, handler):
+        """Serving-plane chaos: when a fault hook is installed
+        (chaos/runtime.py ServingChaos via ``set_fault_hook``), it may
+        answer a request with an injected error status before the real
+        handler runs — exercising client retry paths under test."""
+        hook = self.fault_hook
+        if hook is not None:
+            status = hook(request)
+            if status:
+                return web.json_response(
+                    {"error": "chaos: injected fault"}, status=status
+                )
+        return await handler(request)
 
     @web.middleware
     async def _shed_middleware(self, request: web.Request, handler):
@@ -171,16 +200,16 @@ class Api:
                 {"error": "at least one statement is required"}, status=400
             )
         try:
-            outcome = await make_broadcastable_changes(self.agent, statements)
+            # write + broadcast + local-commit subscription notify in one
+            # step (ref: mod.rs:205 match_changes; agent/agent.py)
+            outcome = await execute_and_notify(
+                self.agent,
+                statements,
+                subs=self.subs,
+                broadcast_hook=self.broadcast_hook,
+            )
         except Exception as e:  # sqlite errors surface as 400s w/ messages
             return web.json_response({"error": str(e)}, status=400)
-        if self.broadcast_hook is not None and outcome.changesets:
-            await self.broadcast_hook(outcome.changesets)
-        if self.subs is not None and outcome.changesets:
-            # local-commit subscription notify (ref: mod.rs:205 match_changes)
-            self.subs.match_changes(
-                [(c.actor_id, c.changeset) for c in outcome.changesets]
-            )
         return web.json_response(
             {
                 "results": [

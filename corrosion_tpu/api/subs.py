@@ -94,7 +94,7 @@ class SubsApi:
         from_id: Optional[int],
         skip_rows: bool,
     ) -> web.StreamResponse:
-        sub = matcher.attach()
+        sub = matcher.attach(queue_size=self.subs.queue_size)
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "application/x-ndjson",
@@ -131,6 +131,11 @@ class SubsApi:
             while True:
                 event = await sub.queue.get()
                 if event.get("__closed"):
+                    # an eviction sentinel may carry a terminal error
+                    # record (slow-consumer policy, pubsub/matcher.py);
+                    # it must reach the wire before the stream ends
+                    if "error" in event:
+                        await write({"error": event["error"]})
                     break
                 # events the snapshot/catch-up already covered
                 if "change" in event and event["change"][3] <= cutoff:

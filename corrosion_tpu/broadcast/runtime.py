@@ -21,6 +21,7 @@ from typing import List, Optional
 
 from ..types.broadcast import ChangeV1
 from ..types.members import Members
+from ..utils.aio import cancel_and_wait
 from ..wire import encode_uni_broadcast
 from ..transport.net import Transport
 
@@ -69,11 +70,7 @@ class BroadcastRuntime:
         self._resend_task = asyncio.create_task(self._resend_loop())
 
     async def stop(self) -> None:
-        for t in (self._task, self._resend_task):
-            if t is not None:
-                t.cancel()
-                with contextlib.suppress(asyncio.CancelledError):
-                    await t
+        await cancel_and_wait(self._task, self._resend_task)
 
     async def enqueue(self, changes: List[ChangeV1], rebroadcast: bool = False) -> None:
         for cv in changes:

@@ -28,18 +28,103 @@ confirm WHICH schedule a run replayed (doc/telemetry.md).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
-from ..sim.rng import TAG_CHAOS_DROP, TAG_CHAOS_DUP, py_below
+from ..sim.rng import (
+    TAG_CHAOS_DROP,
+    TAG_CHAOS_DUP,
+    TAG_SERVE_FAULT,
+    py_below,
+)
 from ..utils.metrics import counter, gauge
 from .lower import LoweredChaos
 
-__all__ = ["ChaosInjector"]
+__all__ = ["ChaosInjector", "ServingChaos", "ServingFaultPlan"]
 
 # on_restart(round, node_index, node) — the comparator re-arms rngs,
 # reseeds membership, reinstalls pairing hooks and replays the node's
 # own writes here (chaos/compare.py); plain harness users can announce
 OnRestart = Callable[[int, int, object], Awaitable[None]]
+
+
+# -- serving-plane faults ---------------------------------------------------
+#
+# The gossip-plane injector above faults links between NODES; the
+# serving plane faults the edge between an agent and its CLIENTS:
+# subscription streams that stall (a reader stops draining, exercising
+# the bounded-queue slow-consumer policy), streams that disconnect
+# mid-flight (exercising client reconnect + ?from= resume), and HTTP
+# requests answered 5xx (exercising the shared retry policy,
+# utils/retry.py).  Verdicts use the same counter-based hash draws as
+# link faults — one draw per (round, stream) keyed on the schedule
+# seed — so a loadgen replay is bit-reproducible fault-for-fault.
+
+
+@dataclass(frozen=True)
+class ServingFaultPlan:
+    """Per-million rates for each serving-plane fault kind."""
+
+    seed: int
+    stall_ppm: int = 0  # reader stops draining for ``stall_rounds``
+    disconnect_ppm: int = 0  # stream cut mid-flight, client must resume
+    http_5xx_ppm: int = 0  # request answered 500 before the handler
+    stall_rounds: int = 2
+
+    @property
+    def any_active(self) -> bool:
+        return bool(self.stall_ppm or self.disconnect_ppm or self.http_5xx_ppm)
+
+
+class ServingChaos:
+    """Deterministic serving-plane fault verdicts.
+
+    ``stream_verdict(r, s)`` is consulted by the loadgen once per round
+    per subscription stream; ``http_verdict(r, k)`` by the HTTP layer's
+    fault hook once per request.  Sub-keys keep the draws independent:
+    key 0 = stall, 1 = disconnect, 2 = http_5xx.
+    """
+
+    def __init__(self, plan: ServingFaultPlan) -> None:
+        self.plan = plan
+        # stream index -> round the current stall expires at
+        self._stalled_until: Dict[int, int] = {}
+
+    def stream_verdict(self, r: int, stream: int) -> Optional[str]:
+        """``"stall"`` / ``"disconnect"`` / None for (round, stream)."""
+        p = self.plan
+        until = self._stalled_until.get(stream)
+        if until is not None:
+            if r < until:
+                return "stall"  # episode still running: no fresh draw
+            del self._stalled_until[stream]
+        if p.stall_ppm and (
+            py_below(1_000_000, p.seed, TAG_SERVE_FAULT, 0, r, stream)
+            < p.stall_ppm
+        ):
+            self._stalled_until[stream] = r + p.stall_rounds
+            counter("corro.chaos.injected.total", kind="sub_stall").inc()
+            return "stall"
+        if p.disconnect_ppm and (
+            py_below(1_000_000, p.seed, TAG_SERVE_FAULT, 1, r, stream)
+            < p.disconnect_ppm
+        ):
+            counter(
+                "corro.chaos.injected.total", kind="sub_disconnect"
+            ).inc()
+            return "disconnect"
+        return None
+
+    def http_verdict(self, r: int, request: int) -> bool:
+        """True → the HTTP layer should answer this request 500."""
+        p = self.plan
+        if p.http_5xx_ppm and (
+            py_below(1_000_000, p.seed, TAG_SERVE_FAULT, 2, r, request)
+            < p.http_5xx_ppm
+        ):
+            counter("corro.chaos.injected.total", kind="http_5xx").inc()
+            return True
+        return False
 
 
 class ChaosInjector:

@@ -365,7 +365,13 @@ async def cmd_consul(args) -> int:
 
 
 async def cmd_tls(args) -> int:
-    from ..utils import tls as tlsmod
+    try:
+        from ..utils import tls as tlsmod
+    except ImportError:
+        _die(
+            "tls commands need the 'cryptography' package, which is not "
+            "installed in this environment"
+        )
 
     if args.tls_cmd == "ca":
         cert, key = tlsmod.generate_ca()

@@ -17,9 +17,17 @@ from typing import Any, AsyncIterator, Dict, Optional
 
 import aiohttp
 
+from ..utils.retry import RetryPolicy
+
 QUERY_ID_HEADER = "corro-query-id"
 RECONNECT_BACKOFF_MIN = 0.1
 RECONNECT_BACKOFF_MAX = 5.0
+
+# shared serving-plane policy (utils/retry.py): capped + jittered so a
+# fleet of clients doesn't stampede a briefly-down agent in lockstep
+RECONNECT_POLICY = RetryPolicy(
+    base=RECONNECT_BACKOFF_MIN, cap=RECONNECT_BACKOFF_MAX
+)
 
 
 class MissedChange(Exception):
@@ -57,6 +65,7 @@ class SubscriptionStream:
         self.last_change_id: Optional[int] = from_id
         self.skip_rows = skip_rows
         self.max_reconnects = max_reconnects
+        self.reconnects = 0  # lifetime reconnect count (loadgen reads it)
         self._resp: Optional[aiohttp.ClientResponse] = None
 
     # -- connection management --------------------------------------------
@@ -107,24 +116,29 @@ class SubscriptionStream:
         return self._events()
 
     async def _events(self) -> AsyncIterator[Dict[str, Any]]:
-        reconnects = 0
-        backoff = RECONNECT_BACKOFF_MIN
+        from . import ClientError
+
+        backoff = RECONNECT_POLICY.backoff()
         while True:
             try:
                 self._resp = await self._connect()
-            except aiohttp.ClientConnectionError:
-                # server not reachable (yet); retry like a drop (sub.rs
-                # reconnects with backoff on transport errors)
-                if (
-                    self.max_reconnects is not None
-                    and reconnects >= self.max_reconnects
+            except (aiohttp.ClientConnectionError, ClientError) as e:
+                # not reachable, or answered 5xx (chaos http_5xx lands
+                # here): transient — retry under the shared policy.
+                # 4xx is a rejection of the request itself: permanent.
+                if isinstance(e, ClientError) and (
+                    e.status is None or e.status < 500
                 ):
                     raise
-                reconnects += 1
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, RECONNECT_BACKOFF_MAX)
+                if (
+                    self.max_reconnects is not None
+                    and backoff.total >= self.max_reconnects
+                ):
+                    raise
+                await backoff.sleep()
+                self.reconnects = backoff.total
                 continue
-            backoff = RECONNECT_BACKOFF_MIN
+            backoff.reset()
             try:
                 async for line in self._resp.content:
                     line = line.strip()
@@ -157,12 +171,11 @@ class SubscriptionStream:
                 await self.close()
             if (
                 self.max_reconnects is not None
-                and reconnects >= self.max_reconnects
+                and backoff.total >= self.max_reconnects
             ):
                 return
-            reconnects += 1
-            await asyncio.sleep(backoff)
-            backoff = min(backoff * 2, RECONNECT_BACKOFF_MAX)
+            await backoff.sleep()
+            self.reconnects = backoff.total
 
     async def changes(self) -> AsyncIterator[Dict[str, Any]]:
         """Yield only change events as {type, rowid, cells, change_id}."""

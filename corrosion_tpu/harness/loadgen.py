@@ -1,0 +1,755 @@
+"""Deterministic serving-plane load generator.
+
+The sim side of this repo can replay one chaos schedule bit-identically
+across backends (chaos/compare.py); the serving plane — HTTP NDJSON
+subscription streams, the PG wire shim, the template watcher — had no
+equivalent driver.  This module closes ROADMAP item 4's loop: it turns a
+sim schedule's **delivery ledger** into per-round change-stream traffic
+against one LIVE agent, and asserts the stream protocol's invariants at
+teardown.
+
+How the ledger becomes traffic
+------------------------------
+
+``build_traffic(schedule, seed)`` replays the exact pairing machinery
+the sim/runtime comparator uses (chaos/pairing.py): ``sim_origins``
+draws which node originates each changeset, and the lowered schedule's
+crash windows (``lower(schedule).dead``) gate which origins are live in
+a given round — a write whose origin is down is re-homed to the next
+live node, the way the runtime ledger holds a dead node's writes until
+its replacement boots.  Every op is a pure function of ``(schedule,
+seed)``: the same inputs produce a byte-identical traffic schedule
+(``schedule_digest``).  A :class:`~corrosion_tpu.sim.flight.FlightRecord`
+can modulate intensity: its per-round ``deliveries`` series becomes the
+per-round write count (``writes_per_round=record.series["deliveries"]``).
+
+``replay()`` then boots an in-process agent (Agent + SubsManager + Api +
+PgServer), applies each op **through the agent pool** at a configurable
+QPS multiplier, fans ``n_subscribers`` concurrent HTTP subscription
+streams plus ``n_pg_readers`` PG-wire readers against it, and at
+teardown checks, per subscriber:
+
+- **monotone change ids** — every live ``change`` event's id is strictly
+  greater than the last (a duplicate or reordering is a violation; a
+  GAP surfaces as the client's ``MissedChange``);
+- **no duplicate / missing rows** — the union of snapshot rows and
+  insert events must equal the applied ledger exactly.
+
+The ``invariant_digest`` hashes the per-subscriber final row sets plus
+all violations: two replays of the same ledger + seed yield identical
+digests (tests/test_loadgen.py pins this).
+
+Slow consumers and chaos
+------------------------
+
+``stalled_subscribers`` attaches N extra matcher-level subscribers that
+never drain — exercising the bounded-queue slow-consumer policy
+(pubsub/matcher.py): their queue depth stays at the configured bound,
+``corro.subs.lagged`` fires at the watermark, and eviction lands on
+``corro.subs.evicted`` with a terminal NDJSON error record.  A
+:class:`~corrosion_tpu.chaos.runtime.ServingFaultPlan` adds sub-stream
+stall/disconnect and HTTP 5xx injection on top (one deterministic draw
+per (round, stream), chaos/runtime.py ``ServingChaos``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
+
+from ..pubsub import LAGGED_ERROR
+from ..utils.aio import cancel_and_wait
+from ..sim.rng import TAG_SERVE, py_below
+from ..utils.metrics import counter
+
+__all__ = [
+    "LoadgenParams",
+    "LoadgenReport",
+    "TrafficOp",
+    "acceptance_schedule",
+    "build_traffic",
+    "run_serve_bench",
+    "schedule_digest",
+    "replay",
+]
+
+# the table the replay writes into; one row per ledger op
+LOADTEST_SCHEMA = (
+    "CREATE TABLE loadtest (id INTEGER NOT NULL PRIMARY KEY, "
+    "origin INTEGER NOT NULL DEFAULT 0, "
+    'text TEXT NOT NULL DEFAULT "")'
+)
+LOADTEST_SQL = "SELECT id, origin, text FROM loadtest"
+
+# qps_multiplier 1.0 paces rounds at this many writes/second; <= 0 runs
+# flat out (the determinism tests want wall-clock out of the equation)
+BASE_QPS = 200.0
+
+CATCH_UP_TIMEOUT = 30.0  # teardown budget for laggards to drain
+
+
+@dataclass(frozen=True)
+class TrafficOp:
+    """One ledger write: pure function of (schedule, seed)."""
+
+    round: int
+    k: int  # global op index
+    origin: int  # schedule node index that "originates" the write
+    row_id: int
+    text: str
+
+    def line(self) -> str:
+        return f"{self.round}:{self.k}:{self.origin}:{self.row_id}:{self.text}"
+
+
+@dataclass(frozen=True)
+class LoadgenParams:
+    n_subscribers: int = 8
+    n_pg_readers: int = 2
+    qps_multiplier: float = 0.0  # <= 0: unpaced
+    seed: int = 0
+    writes_per_round: Union[int, Sequence[int]] = 2
+    queue_size: Optional[int] = None  # per-subscriber bound (None: default)
+    stalled_subscribers: int = 0  # matcher-level never-drained attaches
+    faults: Optional[object] = None  # chaos.runtime.ServingFaultPlan
+
+
+@dataclass
+class LoadgenReport:
+    schedule_digest: str
+    invariant_digest: str
+    violations: List[str]
+    rounds: int
+    writes: int
+    n_subscribers: int
+    events: int  # live change events delivered across subscribers
+    lag_p50: float
+    lag_p99: float
+    matcher_throughput: float  # delivered events / wall second
+    lagged: int  # corro.subs.lagged delta over the replay
+    evicted: int  # corro.subs.evicted delta over the replay
+    reconnects: int  # summed SubscriptionStream reconnects
+    stalled_queue_peak: int  # deepest never-drained queue observed
+    duration: float
+    pg_reads: int = 0
+
+    def to_json(self) -> Dict[str, object]:
+        return dict(self.__dict__, violations=list(self.violations))
+
+
+def _round_weights(
+    n_rounds: int, writes_per_round: Union[int, Sequence[int]]
+) -> List[int]:
+    if isinstance(writes_per_round, int):
+        return [writes_per_round] * n_rounds
+    w = [int(x) for x in writes_per_round]
+    if len(w) < n_rounds:  # a converged-early flight record: pad with 0
+        w = w + [0] * (n_rounds - len(w))
+    return w[:n_rounds]
+
+
+def build_traffic(
+    schedule,
+    seed: int = 0,
+    writes_per_round: Union[int, Sequence[int]] = 2,
+) -> List[TrafficOp]:
+    """The per-round write ledger for ``schedule`` — deterministic.
+
+    Origins replay the pairing machinery's draws (``sim_origins`` keyed
+    on the schedule's own seed); the loadgen ``seed`` perturbs only the
+    payload text, so one schedule can drive many distinct-but-paired
+    traffic runs."""
+    from ..chaos.compare import params_for
+    from ..chaos.lower import lower
+    from ..chaos.pairing import sim_origins
+
+    weights = _round_weights(schedule.n_rounds, writes_per_round)
+    n_ops = sum(weights)
+    p = params_for(schedule, n_changes=max(1, n_ops))
+    origins = sim_origins(p)
+    lowered = lower(schedule)
+
+    ops: List[TrafficOp] = []
+    k = 0
+    for r in range(schedule.n_rounds):
+        for _ in range(weights[r]):
+            origin = origins[k % len(origins)]
+            # dead-origin re-homing: the runtime ledger parks a crashed
+            # node's writes until its replacement boots; the serving
+            # replay instead walks to the next live node — the WALK is
+            # part of the deterministic schedule, not a runtime race
+            for _step in range(schedule.n_nodes):
+                if not bool(lowered.dead[r, origin]):
+                    break
+                origin = (origin + 1) % schedule.n_nodes
+            row_id = k + 1
+            nonce = py_below(1_000_000, seed, TAG_SERVE, r, k)
+            ops.append(
+                TrafficOp(
+                    round=r,
+                    k=k,
+                    origin=int(origin),
+                    row_id=row_id,
+                    text=f"r{r}n{origin:02d}x{nonce:06d}",
+                )
+            )
+            k += 1
+    return ops
+
+
+def schedule_digest(ops: Sequence[TrafficOp]) -> str:
+    h = hashlib.sha256()
+    for op in ops:
+        h.update(op.line().encode())
+        h.update(b"\n")
+    return h.hexdigest()
+
+
+# -- subscribers ------------------------------------------------------------
+
+
+class _HttpSubscriber:
+    """One concurrent NDJSON stream: collects rows/changes, checks the
+    protocol invariants inline, and supports fault-driven stall (stop
+    reading → TCP backpressure) and disconnect (force a resume)."""
+
+    def __init__(self, idx: int, client, write_times: Dict[int, float]) -> None:
+        self.idx = idx
+        self.client = client
+        self.write_times = write_times
+        self.rows: Set[int] = set()  # final materialized row ids
+        self.violations: List[str] = []
+        self.events = 0
+        self.evictions_seen = 0  # terminal lagged records received
+        self.lags: List[float] = []
+        self.last_change_id: Optional[int] = None
+        self.paused = asyncio.Event()
+        self.paused.set()  # set = running; cleared = stalled
+        self.stream = None
+        self.task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self.task = asyncio.create_task(
+            self._run(), name=f"loadgen-sub-{self.idx}"
+        )
+
+    async def _run(self) -> None:
+        from ..client.sub import MissedChange
+
+        self.stream = self.client.subscribe(LOADTEST_SQL)
+        try:
+            async for ev in self.stream:
+                await self.paused.wait()  # chaos stall: stop draining
+                self._observe(ev)
+        except MissedChange as e:
+            self.violations.append(f"sub{self.idx}: {e}")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # transport teardown at shutdown is fine
+            self.violations.append(f"sub{self.idx}: stream died: {e!r}")
+
+    def _observe(self, ev: dict) -> None:
+        if "row" in ev:
+            rowid, cells = ev["row"]
+            row_id = int(cells[0])
+            if row_id in self.rows:
+                self.violations.append(
+                    f"sub{self.idx}: duplicate snapshot row {row_id}"
+                )
+            self.rows.add(row_id)
+        elif "change" in ev:
+            typ, _rowid, cells, change_id = ev["change"]
+            self.events += 1
+            if (
+                self.last_change_id is not None
+                and change_id <= self.last_change_id
+            ):
+                self.violations.append(
+                    f"sub{self.idx}: change id not monotone: "
+                    f"{change_id} after {self.last_change_id}"
+                )
+            self.last_change_id = change_id
+            if typ == "insert":
+                row_id = int(cells[0])
+                if row_id in self.rows:
+                    self.violations.append(
+                        f"sub{self.idx}: duplicate insert for row {row_id}"
+                    )
+                self.rows.add(row_id)
+                t0 = self.write_times.get(row_id)
+                if t0 is not None:
+                    self.lags.append(time.monotonic() - t0)
+        elif "error" in ev:
+            if ev["error"] == LAGGED_ERROR:
+                # the slow-consumer policy working as designed: the stream
+                # ends with an explicit terminal record, and the client
+                # reconnects + catches up from its last consumed id — an
+                # eviction is only a violation if rows end up missing
+                self.evictions_seen += 1
+            else:
+                self.violations.append(
+                    f"sub{self.idx}: stream error: {ev['error']}"
+                )
+
+    async def disconnect(self) -> None:
+        """Chaos: cut the transport; the stream auto-resumes with
+        ``?from=`` under the shared retry policy."""
+        if self.stream is not None:
+            await self.stream.close()
+
+    async def stop(self) -> None:
+        await cancel_and_wait(self.task)
+        if self.stream is not None:
+            await self.stream.close()
+
+    @property
+    def reconnects(self) -> int:
+        return self.stream.reconnects if self.stream is not None else 0
+
+
+class _PgReader:
+    """Minimal PG v3 simple-query reader: periodically counts the
+    loadtest table over the wire (pg/__init__.py serves it)."""
+
+    def __init__(self, port: int, interval: float = 0.05) -> None:
+        self.port = port
+        self.interval = interval
+        self.reads = 0
+        self.last_count = 0
+        self.task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self.task = asyncio.create_task(self._run(), name="loadgen-pg")
+
+    async def _run(self) -> None:
+        reader, writer = await asyncio.open_connection("127.0.0.1", self.port)
+        try:
+            body = struct.pack("!I", 196608)
+            body += b"user\x00loadgen\x00database\x00corrosion\x00\x00"
+            writer.write(struct.pack("!I", len(body) + 4) + body)
+            await writer.drain()
+            while True:  # drain startup until ReadyForQuery
+                kind, payload = await self._msg(reader)
+                if kind == b"Z":
+                    break
+            while True:
+                sql = b"SELECT count(*) FROM loadtest\x00"
+                writer.write(
+                    b"Q" + struct.pack("!I", len(sql) + 4) + sql
+                )
+                await writer.drain()
+                while True:
+                    kind, payload = await self._msg(reader)
+                    if kind == b"D":
+                        (n,) = struct.unpack("!i", payload[2:6])
+                        if n > 0:
+                            self.last_count = int(payload[6 : 6 + n])
+                    elif kind == b"Z":
+                        break
+                self.reads += 1
+                await asyncio.sleep(self.interval)
+        except asyncio.CancelledError:
+            raise
+        except (OSError, asyncio.IncompleteReadError):
+            pass  # server teardown mid-read
+        finally:
+            writer.close()
+
+    @staticmethod
+    async def _msg(reader) -> Tuple[bytes, bytes]:
+        kind = await reader.readexactly(1)
+        (length,) = struct.unpack("!I", await reader.readexactly(4))
+        return kind, await reader.readexactly(length - 4)
+
+    async def stop(self) -> None:
+        await cancel_and_wait(self.task)
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    ys = sorted(xs)
+    i = min(len(ys) - 1, int(round(q * (len(ys) - 1))))
+    return ys[i]
+
+
+# -- replay -----------------------------------------------------------------
+
+
+async def replay(
+    schedule,
+    params: LoadgenParams,
+    subs_path: str,
+) -> LoadgenReport:
+    """Drive the ledger into a live in-process agent and verify the
+    stream protocol end to end (module doc)."""
+    from ..agent import Agent, AgentConfig, execute_and_notify
+    from ..api.http import Api
+    from ..chaos.runtime import ServingChaos
+    from ..client import CorrosionApiClient
+    from ..pg import PgServer
+    from ..pubsub import SubsManager
+    from ..types.schema import apply_schema
+    from ..utils.metrics import counter_snapshot, snapshot_delta
+
+    ops = build_traffic(
+        schedule, seed=params.seed, writes_per_round=params.writes_per_round
+    )
+    sched_digest = schedule_digest(ops)
+    serving = (
+        ServingChaos(params.faults)
+        if params.faults is not None and params.faults.any_active
+        else None
+    )
+
+    agent = Agent(AgentConfig(db_path=":memory:", read_conns=4)).open_sync()
+    await agent.pool.write_call(lambda c: apply_schema(c, LOADTEST_SCHEMA))
+    subs = SubsManager(subs_path, agent.pool, queue_size=params.queue_size)
+    subs.start()
+    api = Api(agent, subs=subs)
+    port = await api.start()
+    pg = PgServer(agent)
+    pg_port = await pg.start()
+
+    if serving is not None:
+        req_counter = [0]
+
+        def _http_fault(request) -> Optional[int]:
+            req_counter[0] += 1
+            # stream routes are faulted via stall/disconnect verdicts;
+            # 5xx injection targets the request/response routes
+            if request.path.startswith("/v1/subscriptions"):
+                return None
+            r = min(schedule.n_rounds - 1, _round_now[0])
+            return 500 if serving.http_verdict(r, req_counter[0]) else None
+
+        api.set_fault_hook(_http_fault)
+    _round_now = [0]
+
+    write_times: Dict[int, float] = {}
+    snap0 = counter_snapshot("corro.subs.")
+    t0 = time.monotonic()
+    subscribers: List[_HttpSubscriber] = []
+    readers: List[_PgReader] = []
+    stalled_subs = []
+    stalled_peak = 0
+    violations: List[str] = []
+    writes = 0
+
+    client = CorrosionApiClient(f"http://127.0.0.1:{port}")
+    try:
+        for i in range(params.n_subscribers):
+            sub = _HttpSubscriber(i, client, write_times)
+            sub.start()
+            subscribers.append(sub)
+        for _ in range(params.n_pg_readers):
+            rd = _PgReader(pg_port)
+            rd.start()
+            readers.append(rd)
+
+        # let every stream land its snapshot before traffic starts, so
+        # the ledger row set is cleanly snapshot ∪ changes per stream
+        matcher, _ = await subs.get_or_insert(LOADTEST_SQL)
+        await asyncio.wait_for(matcher.ready.wait(), 10)
+
+        # never-drained matcher-level attaches: the slow-consumer probe
+        for _ in range(params.stalled_subscribers):
+            stalled_subs.append(
+                matcher.attach(queue_size=subs.queue_size)
+            )
+
+        interval = 0.0
+        if params.qps_multiplier > 0:
+            qps = BASE_QPS * params.qps_multiplier
+            interval = 1.0 / qps
+
+        by_round: Dict[int, List[TrafficOp]] = {}
+        for op in ops:
+            by_round.setdefault(op.round, []).append(op)
+
+        for r in range(schedule.n_rounds):
+            _round_now[0] = r
+            if serving is not None:
+                for s, sub in enumerate(subscribers):
+                    verdict = serving.stream_verdict(r, s)
+                    if verdict == "stall":
+                        sub.paused.clear()
+                    elif verdict == "disconnect":
+                        sub.paused.set()
+                        await sub.disconnect()
+                    else:
+                        sub.paused.set()
+            for op in by_round.get(r, ()):
+                stmts = [
+                    (
+                        "INSERT INTO loadtest (id, origin, text) "
+                        "VALUES (?, ?, ?)",
+                        (op.row_id, op.origin, op.text),
+                    )
+                ]
+                await execute_and_notify(agent, stmts, subs=subs)
+                write_times[op.row_id] = time.monotonic()
+                writes += 1
+                counter("corro.serve.replay.writes").inc()
+                for st in stalled_subs:
+                    stalled_peak = max(stalled_peak, st.queue.qsize())
+                if interval:
+                    await asyncio.sleep(interval)
+            counter("corro.serve.replay.rounds").inc()
+            await asyncio.sleep(0)  # round barrier: let streams drain
+
+        # teardown: un-stall everyone and wait for laggards to catch up
+        for sub in subscribers:
+            sub.paused.set()
+        expected = {op.row_id for op in ops}
+        deadline = time.monotonic() + CATCH_UP_TIMEOUT
+        while time.monotonic() < deadline:
+            if all(sub.rows >= expected for sub in subscribers):
+                break
+            await asyncio.sleep(0.05)
+        duration = time.monotonic() - t0
+
+        for st in stalled_subs:
+            stalled_peak = max(stalled_peak, st.queue.qsize())
+            if st.queue.maxsize and st.queue.qsize() > st.queue.maxsize:
+                violations.append(
+                    f"stalled subscriber queue exceeded bound: "
+                    f"{st.queue.qsize()} > {st.queue.maxsize}"
+                )
+
+        for sub in subscribers:
+            violations.extend(sub.violations)
+            missing = expected - sub.rows
+            extra = sub.rows - expected
+            if missing:
+                violations.append(
+                    f"sub{sub.idx}: missing rows {sorted(missing)[:10]}"
+                    f" ({len(missing)} total)"
+                )
+            if extra:
+                violations.append(
+                    f"sub{sub.idx}: unexpected rows {sorted(extra)[:10]}"
+                )
+    finally:
+        for sub in subscribers:
+            await sub.stop()
+        for rd in readers:
+            await rd.stop()
+        for st in stalled_subs:
+            matcher.detach(st)
+        await client.close()
+        await subs.stop()
+        await pg.stop()
+        await api.stop()
+        agent.close()
+
+    if violations:
+        counter("corro.serve.replay.violations").inc(len(violations))
+
+    inv = hashlib.sha256()
+    inv.update(sched_digest.encode())
+    for sub in subscribers:
+        inv.update(f"sub{sub.idx}:{sorted(sub.rows)}\n".encode())
+    for v in sorted(violations):
+        inv.update(v.encode())
+        inv.update(b"\n")
+
+    lags = [lag for sub in subscribers for lag in sub.lags]
+    events = sum(sub.events for sub in subscribers)
+    delta = snapshot_delta(snap0, counter_snapshot("corro.subs."))
+    return LoadgenReport(
+        schedule_digest=sched_digest,
+        invariant_digest=inv.hexdigest(),
+        violations=violations,
+        rounds=schedule.n_rounds,
+        writes=writes,
+        n_subscribers=params.n_subscribers,
+        events=events,
+        lag_p50=_percentile(lags, 0.50),
+        lag_p99=_percentile(lags, 0.99),
+        matcher_throughput=(events / duration) if duration > 0 else 0.0,
+        lagged=int(delta.get("corro.subs.lagged", 0)),
+        evicted=int(delta.get("corro.subs.evicted", 0)),
+        reconnects=sum(sub.reconnects for sub in subscribers),
+        stalled_queue_peak=stalled_peak,
+        duration=duration,
+        pg_reads=sum(rd.reads for rd in readers),
+    )
+
+
+# -- bench entry point (bench.py --serve) -----------------------------------
+
+
+def acceptance_schedule(seed: int = 3):
+    """The pinned 16-node partition+crash+drop acceptance schedule the
+    chaos suite replays (tests/test_chaos.py) — the serve bench drives
+    the SAME fault trajectory so its numbers are comparable run to run."""
+    from ..chaos.schedule import GenParams, generate
+
+    return generate(
+        GenParams(
+            n_nodes=16, n_rounds=48, seed=seed,
+            partition_frac_ppm=300_000, partition_rounds=6,
+            crash_ppm=40_000, crash_rounds=3, crash_down_rounds=3,
+            drop_ppm=50_000, drop_rounds=8,
+        )
+    )
+
+
+def run_serve_bench(
+    seed: int = 0,
+    qps_multiplier: float = 0.0,
+    subs_path: Optional[str] = None,
+) -> Dict[str, object]:
+    """One serve-replay bench leg → a BENCH JSON line dict.
+
+    Replays the pinned acceptance ledger into a live agent with 8 HTTP
+    subscribers + 2 PG readers and ONE artificially stalled subscriber
+    (the slow-consumer policy must be visible in the stamped
+    lagged/evicted counters; acceptance requires zero stream-invariant
+    violations alongside it)."""
+    import tempfile
+
+    schedule = acceptance_schedule()
+    params = LoadgenParams(
+        n_subscribers=8,
+        n_pg_readers=2,
+        qps_multiplier=qps_multiplier,
+        seed=seed,
+        writes_per_round=2,
+        queue_size=32,
+        stalled_subscribers=1,
+    )
+
+    async def _run() -> LoadgenReport:
+        if subs_path is not None:
+            return await replay(schedule, params, subs_path)
+        with tempfile.TemporaryDirectory() as td:
+            return await replay(schedule, params, td)
+
+    rep = asyncio.run(_run())
+    out: Dict[str, object] = {"metric": "serve_replay"}
+    out.update(
+        n_nodes=schedule.n_nodes,
+        seed=seed,
+        qps_multiplier=qps_multiplier,
+        queue_size=params.queue_size,
+        stalled_subscribers=params.stalled_subscribers,
+        n_pg_readers=params.n_pg_readers,
+    )
+    rj = rep.to_json()
+    rj["violations"] = len(rep.violations)
+    out.update(rj)
+    return out
+
+
+# -- BENCHMARKS.md serve section (generated, never hand-edited) -------------
+
+BEGIN_MARK = (
+    "<!-- serve:begin (generated by corrosion_tpu.harness.loadgen; "
+    "do not hand-edit) -->"
+)
+END_MARK = "<!-- serve:end -->"
+
+
+def serve_markdown(lines: List[dict]) -> str:
+    """Render the serve section from bench JSON lines (bench.py --serve)."""
+    out = [
+        BEGIN_MARK,
+        "",
+        "## Serving plane: ledger replay against a live agent",
+        "",
+        "bench.py --serve replays the pinned 16-node partition+crash+drop",
+        "acceptance ledger (48 rounds, 2 writes/round) through the agent",
+        "pool into 8 concurrent HTTP subscription streams + 2 PG-wire",
+        "readers, with ONE artificially stalled subscriber exercising the",
+        "bounded-queue slow-consumer policy (pubsub/matcher.py).  Stream",
+        "invariants (monotone change ids, no duplicate/missing rows vs",
+        "the ledger) are asserted at teardown; `viol` must be 0.  `lag`",
+        "is write→delivery wall time per change event (dominated by the",
+        "matcher's candidate batching window).",
+        "",
+        "| writes | events | evt/s | lag p50 | lag p99 | lagged | evicted"
+        " | reconn | viol | invariant digest |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for ln in lines:
+        if ln.get("metric") != "serve_replay":
+            continue
+        out.append(
+            "| {w} | {e} | {tp:.0f} | {p50:.3f}s | {p99:.3f}s | {lag} |"
+            " {ev} | {rc} | {v} | `{d}` |".format(
+                w=ln.get("writes", "?"),
+                e=ln.get("events", "?"),
+                tp=float(ln.get("matcher_throughput", 0.0)),
+                p50=float(ln.get("lag_p50", 0.0)),
+                p99=float(ln.get("lag_p99", 0.0)),
+                lag=ln.get("lagged", "?"),
+                ev=ln.get("evicted", "?"),
+                rc=ln.get("reconnects", "?"),
+                v=ln.get("violations", "?"),
+                d=str(ln.get("invariant_digest", "?"))[:16],
+            )
+        )
+    out += ["", END_MARK]
+    return "\n".join(out)
+
+
+def update_benchmarks(bench_json_path: str, md_path: str) -> None:
+    """Replace (or append) the marker-delimited serve section of
+    ``md_path`` — same contract as the convergence section
+    (sim/flight.py)."""
+    lines = []
+    with open(bench_json_path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    lines.append(json.loads(raw))
+                except json.JSONDecodeError:
+                    pass
+    section = serve_markdown(lines)
+    with open(md_path) as f:
+        doc = f.read()
+    if BEGIN_MARK in doc and END_MARK in doc:
+        head, rest = doc.split(BEGIN_MARK, 1)
+        _, tail = rest.split(END_MARK, 1)
+        doc = head + section + tail
+    else:
+        doc = doc.rstrip("\n") + "\n\n" + section + "\n"
+    with open(md_path, "w") as f:
+        f.write(doc)
+
+
+def main() -> None:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        description="serve-replay bench / BENCHMARKS.md section generator"
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--qps", type=float, default=0.0)
+    ap.add_argument(
+        "--update-benchmarks",
+        action="store_true",
+        help="regenerate the BENCHMARKS.md serve section from --bench",
+    )
+    ap.add_argument("--bench", default="BENCH_serve.json")
+    ap.add_argument("--md", default="BENCHMARKS.md")
+    args = ap.parse_args()
+
+    if args.update_benchmarks:
+        update_benchmarks(args.bench, args.md)
+        print(f"updated {args.md} from {args.bench}", file=sys.stderr)
+        return
+    print(json.dumps(run_serve_bench(args.seed, args.qps)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -47,7 +47,7 @@ import struct
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from ..agent import Agent, make_broadcastable_changes
+from ..agent import Agent, execute_and_notify
 from . import parser as pgparser
 from . import sql_state
 from .sql_state import PgError, map_exception
@@ -97,12 +97,22 @@ def _cached_catalog(conn, cache: Optional[Dict[int, bytes]]):
         cache.clear()
         src = build_catalog(conn)
         try:
-            blob = src.serialize()
+            if hasattr(src, "serialize"):
+                blob = src.serialize()
+            else:
+                # sqlite3.Connection.{serialize,deserialize} landed in
+                # py3.11; on 3.10 cache the schema+rows as a SQL script
+                # instead (same Dict[int, bytes] shape, same exact
+                # invalidation — only the rehydrate step differs).
+                blob = "\n".join(src.iterdump()).encode()
         finally:
             src.close()
         cache[version] = blob
     cat = sqlite3.connect(":memory:")
-    cat.deserialize(blob)
+    if hasattr(cat, "deserialize"):
+        cat.deserialize(blob)
+    else:
+        cat.executescript(blob.decode())
     _register_pg_functions(cat)
     return cat
 
@@ -830,15 +840,12 @@ class PgServer:
     async def _apply_writes(self, writes: List[Tuple[str, Tuple]]):
         """Writes go through the same version/broadcast path as HTTP
         (ref: corro-pg importing the broadcast plumbing, lib.rs:16-23)."""
-        outcome = await make_broadcastable_changes(self.agent, writes)
-        if outcome.changesets:
-            if self.broadcast_hook is not None:
-                await self.broadcast_hook(outcome.changesets)
-            if self.subs is not None:
-                self.subs.match_changes(
-                    [(c.actor_id, c.changeset) for c in outcome.changesets]
-                )
-        return outcome
+        return await execute_and_notify(
+            self.agent,
+            writes,
+            subs=self.subs,
+            broadcast_hook=self.broadcast_hook,
+        )
 
     # -- extended protocol -------------------------------------------------
 

@@ -19,7 +19,15 @@ import uuid
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
-from .matcher import Matcher, Subscriber, SubscriberLagged
+from .matcher import (
+    LAGGED_ERROR,
+    SUBSCRIBER_LAG_WATERMARK,
+    SUBSCRIBER_QUEUE_SIZE,
+    Matcher,
+    Subscriber,
+    SubscriberLagged,
+)
+from ..utils.aio import cancel_and_wait
 from .sql import MatcherError, normalize_sql
 
 __all__ = [
@@ -28,6 +36,9 @@ __all__ = [
     "MatcherError",
     "Subscriber",
     "SubscriberLagged",
+    "LAGGED_ERROR",
+    "SUBSCRIBER_LAG_WATERMARK",
+    "SUBSCRIBER_QUEUE_SIZE",
     "normalize_sql",
 ]
 
@@ -40,9 +51,14 @@ GC_TICK = 30.0
 class SubsManager:
     """Registry of live subscription matchers (ref: SubsManager)."""
 
-    def __init__(self, subs_path: str, pool) -> None:
+    def __init__(
+        self, subs_path: str, pool, queue_size: Optional[int] = None
+    ) -> None:
         self.subs_path = Path(subs_path)
         self.pool = pool
+        # per-subscriber queue bound the HTTP layer attaches with; the
+        # slow-consumer policy (matcher.py) makes this a hard memory cap
+        self.queue_size = queue_size or SUBSCRIBER_QUEUE_SIZE
         self.by_id: Dict[str, Matcher] = {}
         self.by_sql: Dict[str, Matcher] = {}
         self._lock = asyncio.Lock()
@@ -54,11 +70,8 @@ class SubsManager:
         self._gc_task = asyncio.create_task(self._gc_loop(), name="subs-gc")
 
     async def stop(self) -> None:
-        if self._gc_task is not None:
-            self._gc_task.cancel()
-            with contextlib.suppress(asyncio.CancelledError):
-                await self._gc_task
-            self._gc_task = None
+        await cancel_and_wait(self._gc_task)
+        self._gc_task = None
         for matcher in list(self.by_id.values()):
             await matcher.stop()
         self.by_id.clear()

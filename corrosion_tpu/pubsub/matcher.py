@@ -36,7 +36,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..types.change import SqliteValue, jsonify_cell as _encode_cell
 from ..types.columns import pack_columns
-from ..utils.metrics import counter
+from ..utils.aio import cancel_and_wait
+from ..utils.metrics import counter, gauge
 from . import sql as sqlmod
 from .sql import MatcherError, ParsedSelect
 
@@ -47,7 +48,16 @@ CANDIDATE_BATCH_WINDOW = 0.6  # ref: 600 ms aggregation window
 PURGE_INTERVAL = 300.0  # ref: 5 min purge cadence
 CHANGES_RETENTION = 10_000  # newest change rows kept for catch-up
 SUBSCRIBER_QUEUE_SIZE = 1024
+# queue depth (as a fraction of the queue bound) past which a subscriber
+# counts as lagging; crossing it is the operator's early warning before
+# the bound is hit and the subscriber is evicted
+SUBSCRIBER_LAG_WATERMARK = 0.5
 MAX_SQL_VARS = 400  # per-query bound-variable budget (SQLite limit is 999+)
+
+# the terminal NDJSON record an evicted subscriber receives; the stream
+# loop writes it before closing so slow clients learn WHY they were cut
+# (and can reconnect with ?from= rather than a full re-snapshot)
+LAGGED_ERROR = "subscription lagged too far behind and was evicted"
 
 
 def _cells_json(cells: Sequence[SqliteValue]) -> str:
@@ -62,6 +72,11 @@ class SubscriberLagged(Exception):
 class Subscriber:
     queue: asyncio.Queue
     closed: bool = False
+    lagging: bool = False  # above the lag watermark (counted once per episode)
+
+    @property
+    def watermark(self) -> int:
+        return max(1, int(self.queue.maxsize * SUBSCRIBER_LAG_WATERMARK))
 
     def push(self, event: dict) -> None:
         try:
@@ -71,16 +86,26 @@ class Subscriber:
 
     def close(self, event: Optional[dict] = None) -> None:
         """Deliver a ``__closed`` sentinel even when the queue is full, so
-        the HTTP stream loop always terminates after draining."""
+        the HTTP stream loop always terminates after draining.
+
+        A full queue is discarded WHOLE, never trimmed from the front:
+        delivering a suffix of the backlog would hand the client a silent
+        change-id gap (its MissedChange detection fires on data that was
+        never actually purged).  Dropping everything keeps the stream
+        honest — the client's last consumed id is still accurate, and the
+        reconnect catch-up replays the discarded events from the changes
+        log."""
         self.closed = True
         sentinel = event or {"eoq": None, "__closed": True}
-        while True:
-            try:
-                self.queue.put_nowait(sentinel)
-                return
-            except asyncio.QueueFull:
-                with contextlib.suppress(asyncio.QueueEmpty):
+        try:
+            self.queue.put_nowait(sentinel)
+        except asyncio.QueueFull:
+            while True:
+                try:
                     self.queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+            self.queue.put_nowait(sentinel)
 
 
 class Matcher:
@@ -252,11 +277,10 @@ class Matcher:
         self._task = asyncio.create_task(self._run(), name=f"matcher-{self.id}")
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            with contextlib.suppress(asyncio.CancelledError):
-                await self._task
-            self._task = None
+        # the candidate-window wait_for below can swallow a same-tick
+        # cancel (GH-86296) — re-issue until the loop really exits
+        await cancel_and_wait(self._task)
+        self._task = None
         for sub in self._subs:
             sub.close()
         self._subs.clear()
@@ -299,11 +323,16 @@ class Matcher:
 
     # -- event fan-out -----------------------------------------------------
 
-    def attach(self) -> Subscriber:
+    def attach(self, queue_size: Optional[int] = None) -> Subscriber:
         """Register a live-event subscriber.  The HTTP layer deduplicates
         the queue against the change-id cutoff of its snapshot/catch-up
-        read, so attach-before-read never loses or duplicates events."""
-        sub = Subscriber(queue=asyncio.Queue(maxsize=SUBSCRIBER_QUEUE_SIZE))
+        read, so attach-before-read never loses or duplicates events.
+
+        ``queue_size`` overrides the bound (tests and the loadgen shrink
+        it to exercise the slow-consumer policy without 1024 events)."""
+        sub = Subscriber(
+            queue=asyncio.Queue(maxsize=queue_size or SUBSCRIBER_QUEUE_SIZE)
+        )
         self._subs.append(sub)
         self.last_seen = time.monotonic()
         return sub
@@ -324,15 +353,33 @@ class Matcher:
         self.last_seen = time.monotonic()
 
     def _publish(self, event: dict) -> None:
+        """Fan one event out under the slow-consumer policy: queues are
+        BOUNDED, crossing the lag watermark bumps ``corro.subs.lagged``
+        once per episode, and an overflowing subscriber is evicted with a
+        terminal NDJSON error record — never buffered without bound."""
         dead: List[Subscriber] = []
+        depth_high = 0
         for sub in self._subs:
             try:
                 sub.push(event)
             except SubscriberLagged:
                 dead.append(sub)
+                continue
+            depth = sub.queue.qsize()
+            depth_high = max(depth_high, depth)
+            if depth >= sub.watermark:
+                if not sub.lagging:
+                    sub.lagging = True
+                    counter("corro.subs.lagged", sub=self.id[:8]).inc()
+            elif sub.lagging and depth <= sub.watermark // 2:
+                sub.lagging = False  # drained; re-arm the episode counter
+        gauge("corro.subs.queue_depth", sub=self.id[:8]).set(depth_high)
         for sub in dead:
-            logger.warning("subscription %s: dropping lagged subscriber", self.id)
-            sub.close()  # sentinel must land or the stream loop hangs forever
+            logger.warning("subscription %s: evicting lagged subscriber", self.id)
+            counter("corro.subs.evicted", sub=self.id[:8]).inc()
+            # sentinel must land or the stream loop hangs forever; the
+            # error payload becomes the stream's terminal NDJSON record
+            sub.close({"error": LAGGED_ERROR, "__closed": True})
             self._subs.remove(sub)
 
     # -- snapshot reads (used by the HTTP layer for catch-up) --------------

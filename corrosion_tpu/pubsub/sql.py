@@ -330,5 +330,10 @@ def referenced_tables(conn: sqlite3.Connection, sql: str) -> Set[str]:
         # prepare-only: LIMIT 0 still compiles the full statement
         conn.execute(f"SELECT * FROM ({sql}) LIMIT 0").fetchall()
     finally:
-        conn.set_authorizer(None)
+        # set_authorizer(None) only clears the hook on py>=3.11
+        # (bpo-44491); on 3.10 it installs a deny-everything callback,
+        # so every later statement on this pooled connection fails with
+        # "not authorized".  Install a permissive hook instead — same
+        # net effect as no authorizer at all.
+        conn.set_authorizer(lambda *a: sqlite3.SQLITE_OK)
     return tables

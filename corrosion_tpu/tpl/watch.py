@@ -25,11 +25,16 @@ import tempfile
 from typing import List, Optional
 
 from . import Engine, TemplateError, compile_template
+from ..utils.retry import RetryPolicy
 
 logger = logging.getLogger(__name__)
 
 MTIME_POLL_INTERVAL = 1.0
 RERENDER_DEBOUNCE = 0.1
+
+# shared serving-plane policy (utils/retry.py); templates back off more
+# gently than the client's stream reconnect — a render is heavier work
+WATCH_RETRY_POLICY = RetryPolicy(base=1.0, cap=15.0)
 
 
 def parse_template_spec(spec: str) -> tuple:
@@ -122,14 +127,14 @@ class TemplateWatcher:
         from ..client import ClientError
         from ..client.sub import MissedChange
 
-        backoff = 1.0
+        backoff = WATCH_RETRY_POLICY.backoff()
         while True:
             try:
                 stream = self.client.subscribe(sql_text, skip_rows=True)
                 async for event in stream:
                     if "change" in event:
                         self._wake.set()
-                        backoff = 1.0
+                        backoff.reset()
             except asyncio.CancelledError:
                 raise
             except MissedChange:
@@ -153,14 +158,12 @@ class TemplateWatcher:
                 logger.warning(
                     "template sub for %r failed (%s); retrying", sql_text, e
                 )
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 15.0)
+                await backoff.sleep()
             except Exception as e:
                 logger.warning(
                     "template sub for %r failed (%s); retrying", sql_text, e
                 )
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 15.0)
+                await backoff.sleep()
 
     async def _watch_mtime(self) -> None:
         last = os.stat(self.src).st_mtime_ns

@@ -1,0 +1,64 @@
+"""Asyncio shutdown helpers for the agent runtime.
+
+The one export, :func:`cancel_and_wait`, exists because the obvious
+teardown idiom is not actually reliable on this interpreter::
+
+    task.cancel()
+    with contextlib.suppress(asyncio.CancelledError):
+        await task          # can wait forever
+
+On Python 3.10, ``asyncio.wait_for`` swallows a cancellation that lands
+on the same tick its inner future completes (cpython GH-86296, fixed in
+3.12): the inner result is returned, the ``CancelledError`` is consumed,
+and the awaiting loop keeps running with the one-and-only cancel request
+spent.  Every long-lived loop that batches with
+``wait_for(queue.get(), timeout)`` — change ingestion, the subscription
+matcher's candidate window, the native-transport reader — is exposed:
+traffic arriving in the same tick as ``stop()`` eats the cancel and the
+caller's ``await task`` hangs the whole teardown (observed as a
+multi-minute test-suite stall in ``DevCluster.__aexit__``).
+
+The fix is to keep re-issuing the cancel until the task actually
+finishes; a task that exits normally between cancels is fine too.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+__all__ = ["cancel_and_wait"]
+
+# How long to wait for a cancelled task to finish before assuming the
+# request was swallowed and re-issuing it.  One loop tick would do; a
+# generous interval keeps the re-cancel loop quiet on healthy paths
+# (cleanup handlers inside the task may legitimately take time).
+CANCEL_POKE_INTERVAL = 1.0
+
+
+async def cancel_and_wait(
+    *tasks: Optional[asyncio.Task],
+    poke_interval: float = CANCEL_POKE_INTERVAL,
+) -> None:
+    """Cancel ``tasks`` and wait until every one has truly finished.
+
+    Re-issues the cancellation every ``poke_interval`` seconds until the
+    task completes, so a swallowed ``CancelledError`` (GH-86296, or a
+    loop body that caught it once) cannot hang the caller.  ``None``
+    entries are skipped.  ``CancelledError`` outcomes are absorbed; a
+    task that died with any other exception re-raises it here, matching
+    the plain ``await task`` idiom this replaces.
+    """
+    live = [t for t in tasks if t is not None]
+    for t in live:
+        t.cancel()
+    while live:
+        done, pending = await asyncio.wait(live, timeout=poke_interval)
+        for t in done:
+            if not t.cancelled():
+                exc = t.exception()
+                if exc is not None:
+                    raise exc
+        live = list(pending)
+        for t in live:
+            t.cancel()

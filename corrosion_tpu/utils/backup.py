@@ -68,16 +68,19 @@ def backup(db_path: str, out_path: str) -> None:
 
 def _clean_snapshot(conn: sqlite3.Connection) -> None:
     """Make the snapshot site-neutral + strip per-node state."""
+    # no RETURNING here: this container's sqlite (3.34) predates it
+    # (3.35+); split into SELECT + DELETE and read the fresh ordinal off
+    # lastrowid (ordinal is the table's INTEGER PRIMARY KEY).
     row = conn.execute(
-        "DELETE FROM crsql_site_id WHERE ordinal = 0 RETURNING site_id"
+        "SELECT site_id FROM crsql_site_id WHERE ordinal = 0"
     ).fetchone()
     if row is None:
         raise BackupError("source database has no site id at ordinal 0")
     site_id = bytes(row[0])
+    conn.execute("DELETE FROM crsql_site_id WHERE ordinal = 0")
     new_ordinal = conn.execute(
-        "INSERT INTO crsql_site_id (site_id) VALUES (?) RETURNING ordinal",
-        (site_id,),
-    ).fetchone()[0]
+        "INSERT INTO crsql_site_id (site_id) VALUES (?)", (site_id,)
+    ).lastrowid
     for table in _clock_tables(conn):
         conn.execute(
             f'UPDATE "{table}" SET site_id = ? WHERE site_id = 0',
@@ -101,10 +104,14 @@ def restore_site_swap(backup_path: str, site_id: bytes) -> Optional[int]:
     conn = sqlite3.connect(backup_path, isolation_level=None)
     try:
         row = conn.execute(
-            "DELETE FROM crsql_site_id WHERE site_id = ? RETURNING ordinal",
+            "SELECT ordinal FROM crsql_site_id WHERE site_id = ?",
             (site_id,),
         ).fetchone()
         ordinal = row[0] if row is not None else None
+        if ordinal is not None:
+            conn.execute(
+                "DELETE FROM crsql_site_id WHERE ordinal = ?", (ordinal,)
+            )
         conn.execute(
             "INSERT OR REPLACE INTO crsql_site_id (ordinal, site_id) "
             "VALUES (0, ?)",

@@ -1,0 +1,136 @@
+"""Shared retry/timeout/backoff policy for the serving plane.
+
+One policy object describes capped exponential backoff with jitter; a
+:class:`Backoff` carries one loop's attempt state.  Before this module,
+``client/sub.py`` and ``tpl/watch.py`` each hand-rolled the same
+double-until-cap loop with different constants and no jitter — every
+client of a briefly-down agent woke on the same schedule (thundering
+herd on reconnect, the failure mode PAPERS.md's bounded-staleness work
+warns about on the sync side).
+
+Design constraints:
+
+- **capped**: delays grow ``base * multiplier**attempt`` up to ``cap``;
+- **jittered**: each delay is scaled by a uniform draw in
+  ``[1 - jitter, 1 + jitter]`` so retriers decorrelate.  The draw comes
+  from an injectable ``random.Random`` so tests (and the deterministic
+  loadgen) can pin it;
+- **cancellation-safe**: sleeping is a bare ``asyncio.sleep`` —
+  ``CancelledError`` propagates immediately and is never swallowed, so
+  a watcher teardown can't hang on a backoff;
+- **bounded (optionally)**: ``max_attempts`` makes :func:`retry` and
+  :meth:`Backoff.sleep` raise instead of spinning forever; ``timeout``
+  bounds each individual attempt in :func:`retry`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+__all__ = [
+    "RetryPolicy",
+    "Backoff",
+    "RetryExhausted",
+    "retry",
+]
+
+
+class RetryExhausted(Exception):
+    """The policy's ``max_attempts`` ran out."""
+
+    def __init__(self, attempts: int) -> None:
+        super().__init__(f"retry policy exhausted after {attempts} attempts")
+        self.attempts = attempts
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff with proportional jitter."""
+
+    base: float = 0.1  # first delay, seconds
+    cap: float = 5.0  # delay ceiling, seconds
+    multiplier: float = 2.0
+    jitter: float = 0.1  # ± fraction of each delay
+    max_attempts: Optional[int] = None  # None = retry forever
+    timeout: Optional[float] = None  # per-attempt budget for retry()
+
+    def delay(self, attempt: int) -> float:
+        """The pre-jitter delay for 0-based ``attempt``."""
+        return min(self.base * self.multiplier**attempt, self.cap)
+
+    def backoff(self, rng: Optional[random.Random] = None) -> "Backoff":
+        return Backoff(self, rng=rng)
+
+
+class Backoff:
+    """One retry loop's state: count attempts, sleep between them.
+
+    ``reset()`` after a success returns the loop to the base delay while
+    keeping the lifetime ``total`` count (callers export it as a
+    reconnect metric).
+    """
+
+    def __init__(
+        self, policy: RetryPolicy, rng: Optional[random.Random] = None
+    ) -> None:
+        self.policy = policy
+        self.attempt = 0  # since the last reset
+        self.total = 0  # lifetime
+        self._rng = rng if rng is not None else random
+
+    @property
+    def exhausted(self) -> bool:
+        return (
+            self.policy.max_attempts is not None
+            and self.total >= self.policy.max_attempts
+        )
+
+    def reset(self) -> None:
+        self.attempt = 0
+
+    def next_delay(self) -> float:
+        """Consume one attempt and return its jittered delay."""
+        if self.exhausted:
+            raise RetryExhausted(self.total)
+        d = self.policy.delay(self.attempt)
+        if self.policy.jitter:
+            lo, hi = 1.0 - self.policy.jitter, 1.0 + self.policy.jitter
+            d *= self._rng.uniform(lo, hi)
+        self.attempt += 1
+        self.total += 1
+        return d
+
+    async def sleep(self) -> None:
+        """Wait out the next delay (cancellation propagates)."""
+        await asyncio.sleep(self.next_delay())
+
+
+async def retry(
+    fn: Callable[[], Awaitable[T]],
+    policy: RetryPolicy,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    on_retry: Optional[Callable[[BaseException, int], None]] = None,
+    rng: Optional[random.Random] = None,
+) -> T:
+    """Run ``fn`` until it succeeds, sleeping per ``policy`` between
+    failures.  ``asyncio.CancelledError`` always propagates (it is not
+    an ``Exception``); ``asyncio.TimeoutError`` from the per-attempt
+    ``policy.timeout`` is retried like any other failure when listed in
+    ``retry_on``."""
+    backoff = policy.backoff(rng=rng)
+    while True:
+        try:
+            if policy.timeout is not None:
+                return await asyncio.wait_for(fn(), policy.timeout)
+            return await fn()
+        except retry_on as e:
+            if backoff.exhausted:
+                raise
+            if on_retry is not None:
+                on_retry(e, backoff.total)
+            await backoff.sleep()

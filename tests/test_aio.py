@@ -1,0 +1,77 @@
+"""utils/aio.py cancel_and_wait — teardown must survive swallowed cancels.
+
+On py3.10, ``asyncio.wait_for`` can swallow a cancellation that lands on
+the same tick its inner future completes (cpython GH-86296): the task
+consumes the one-and-only cancel request and keeps running, so the
+classic ``task.cancel(); await task`` teardown hangs forever.  Observed
+in the wild as DevCluster.__aexit__ stalling the whole suite inside
+ChangeIngest.stop() while gossip traffic was still arriving.
+"""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.utils.aio import cancel_and_wait
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_reissues_swallowed_cancel():
+    """A loop that eats the first CancelledError (the GH-86296 effect)
+    still gets torn down — cancel_and_wait keeps poking."""
+    swallowed = []
+
+    async def stubborn():
+        try:
+            await asyncio.sleep(60)
+        except asyncio.CancelledError:
+            swallowed.append(1)  # the wait_for race, modeled directly
+        await asyncio.sleep(60)  # loop "keeps running"
+
+    async def main():
+        t = asyncio.ensure_future(stubborn())
+        await asyncio.sleep(0)
+        await asyncio.wait_for(
+            cancel_and_wait(t, poke_interval=0.05), timeout=5
+        )
+        assert t.done()
+
+    run(main())
+    assert swallowed == [1]
+
+
+def test_plain_cancel_and_normal_exit_and_none():
+    async def well_behaved():
+        await asyncio.sleep(60)
+
+    async def finishes():
+        return 7
+
+    async def main():
+        t1 = asyncio.ensure_future(well_behaved())
+        t2 = asyncio.ensure_future(finishes())
+        await asyncio.sleep(0)
+        # None entries are skipped; normal completion between cancels is
+        # fine; CancelledError outcomes are absorbed
+        await asyncio.wait_for(
+            cancel_and_wait(None, t1, t2, poke_interval=0.05), timeout=5
+        )
+        assert t1.cancelled() and t2.done()
+
+    run(main())
+
+
+def test_propagates_real_exception():
+    async def dies():
+        raise ValueError("boom")
+
+    async def main():
+        t = asyncio.ensure_future(dies())
+        await asyncio.sleep(0)
+        with pytest.raises(ValueError, match="boom"):
+            await cancel_and_wait(t, poke_interval=0.05)
+
+    run(main())

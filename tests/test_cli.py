@@ -171,9 +171,29 @@ def test_template_once(live_agent):
     assert dst.read_text() == "1=hello\n"
 
 
+def test_tls_degrades_without_crypto_backend(tmp_path):
+    """Without the optional ``cryptography`` package, ``corro tls`` must
+    exit 1 with a clear error — not an ImportError traceback."""
+    try:
+        import cryptography  # noqa: F401
+    except ImportError:
+        pass
+    else:
+        pytest.skip("crypto backend installed; degradation path unreachable")
+    proc = cli(
+        ["tls", "ca", "--cert", str(tmp_path / "c.pem"),
+         "--key", str(tmp_path / "k.pem")],
+        check=False,
+    )
+    assert proc.returncode == 1
+    assert "cryptography" in proc.stderr
+    assert "Traceback" not in proc.stderr
+
+
 def test_tls_generation(tmp_path):
     import ssl
 
+    pytest.importorskip("cryptography")
     ca_cert = tmp_path / "ca_cert.pem"
     ca_key = tmp_path / "ca_key.pem"
     cli(

@@ -512,6 +512,22 @@ def test_cli_lint_fleet_package_clean_at_warning():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+def test_cli_lint_serving_plane_clean_at_warning():
+    """ISSUE satellite (PR 11): the serving-plane packages — HTTP api,
+    pubsub matcher with its bounded-queue paths (GL2xx async-lock rules
+    apply), PG wire, template watcher, and the loadgen harness — hold
+    the warning bar."""
+    proc = cli_lint([
+        "--fail-on=warning",
+        "corrosion_tpu/api",
+        "corrosion_tpu/pubsub",
+        "corrosion_tpu/pg",
+        "corrosion_tpu/tpl",
+        "corrosion_tpu/harness",
+    ])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
 # -- fleet vmap over a done-gated scan: trace-safety fixtures -----------------
 
 def test_gl101_python_branch_on_done_under_vmap():

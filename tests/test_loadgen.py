@@ -1,0 +1,234 @@
+"""Serving-plane load generator + slow-consumer policy tests (PR 11).
+
+Covers the two ISSUE satellite-4 guarantees:
+
+- slow-consumer backpressure: a stalled reader's queue stays bounded at
+  the configured size, crossing the watermark / overflowing increments
+  ``corro.subs.lagged`` / ``corro.subs.evicted``, and OTHER subscribers
+  on the same matcher are unaffected;
+- loadgen determinism: the same ledger + seed produce a byte-identical
+  traffic schedule and the same final invariant digest, with zero
+  stream-invariant violations.
+"""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.agent import Agent, AgentConfig, execute_and_notify
+from corrosion_tpu.chaos.runtime import ServingChaos, ServingFaultPlan
+from corrosion_tpu.chaos.schedule import GenParams, generate
+from corrosion_tpu.harness import loadgen
+from corrosion_tpu.harness.loadgen import (
+    LoadgenParams,
+    build_traffic,
+    replay,
+    schedule_digest,
+)
+from corrosion_tpu.pubsub import (
+    LAGGED_ERROR,
+    SubsManager,
+)
+from corrosion_tpu.pubsub import matcher as matcher_mod
+from corrosion_tpu.types.schema import apply_schema
+from corrosion_tpu.utils.metrics import counter_snapshot, snapshot_delta
+
+SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, "
+    'text TEXT NOT NULL DEFAULT "")'
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def fast_batching(monkeypatch):
+    monkeypatch.setattr(matcher_mod, "CANDIDATE_BATCH_WINDOW", 0.05)
+
+
+def small_schedule(**over):
+    gp = dict(
+        n_nodes=4, n_rounds=8, seed=5,
+        crash_ppm=80_000, crash_rounds=4, crash_down_rounds=2,
+    )
+    gp.update(over)
+    return generate(GenParams(**gp))
+
+
+# ---------------------------------------------------------------------------
+# slow-consumer backpressure (pubsub/matcher.py policy)
+# ---------------------------------------------------------------------------
+
+
+def test_stalled_reader_bounded_queue_eviction_others_unaffected(tmp_path):
+    async def main():
+        agent = Agent(AgentConfig(db_path=":memory:", read_conns=2)).open_sync()
+        await agent.pool.write_call(lambda c: apply_schema(c, SCHEMA))
+        subs = SubsManager(str(tmp_path / "subs"), agent.pool, queue_size=8)
+        subs.start()
+        try:
+            m, _ = await subs.get_or_insert("SELECT id, text FROM tests")
+            await asyncio.wait_for(m.ready.wait(), 10)
+            stalled = m.attach(queue_size=8)  # never drained
+            healthy = m.attach(queue_size=64)
+
+            snap = counter_snapshot("corro.subs.")
+            for i in range(1, 21):
+                await execute_and_notify(
+                    agent,
+                    [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, "x"))],
+                    subs=subs,
+                )
+                # bounded at ALL times, not just at the end
+                assert stalled.queue.qsize() <= stalled.queue.maxsize == 8
+            # wait for the matcher to process every candidate batch
+            got = []
+            while len(got) < 20:
+                ev = await asyncio.wait_for(healthy.queue.get(), 10)
+                assert "change" in ev
+                got.append(ev["change"][3])
+
+            # the healthy subscriber saw every change, in order
+            assert got == sorted(got) and len(set(got)) == 20
+            # the stalled one was evicted with the terminal error record
+            assert stalled.closed
+            drained = []
+            while not stalled.queue.empty():
+                drained.append(stalled.queue.get_nowait())
+            assert drained[-1].get("__closed")
+            assert drained[-1].get("error") == LAGGED_ERROR
+            delta = snapshot_delta(snap, counter_snapshot("corro.subs."))
+            assert delta.get("corro.subs.lagged", 0) >= 1
+            assert delta.get("corro.subs.evicted", 0) >= 1
+        finally:
+            await subs.stop()
+            agent.close()
+
+    run(main())
+
+
+def test_eviction_discards_backlog_whole_no_silent_gap():
+    """close() on a full queue must not trim oldest events to make room
+    for the sentinel — a delivered suffix is a silent change-id gap."""
+    sub = matcher_mod.Subscriber(queue=asyncio.Queue(maxsize=4))
+
+    async def main():
+        for i in range(4):
+            sub.push({"change": ["insert", i, [i], i + 1]})
+        sub.close({"error": LAGGED_ERROR, "__closed": True})
+        first = sub.queue.get_nowait()
+        assert first.get("__closed") and first["error"] == LAGGED_ERROR
+        assert sub.queue.empty()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# traffic schedule determinism (pure, no I/O)
+# ---------------------------------------------------------------------------
+
+
+def test_build_traffic_deterministic_and_seed_sensitive():
+    s = small_schedule()
+    a = build_traffic(s, seed=7, writes_per_round=3)
+    b = build_traffic(s, seed=7, writes_per_round=3)
+    assert [op.line() for op in a] == [op.line() for op in b]
+    assert schedule_digest(a) == schedule_digest(b)
+    c = build_traffic(s, seed=8, writes_per_round=3)
+    assert schedule_digest(c) != schedule_digest(a)
+    # row ids form the exact ledger 1..N
+    assert [op.row_id for op in a] == list(range(1, len(a) + 1))
+
+
+def test_build_traffic_rehomes_dead_origins():
+    from corrosion_tpu.chaos.lower import lower
+
+    s = small_schedule(crash_ppm=200_000)
+    lowered = lower(s)
+    assert lowered.dead.any(), "schedule must actually kill someone"
+    for op in build_traffic(s, seed=0, writes_per_round=2):
+        assert not bool(lowered.dead[op.round, op.origin])
+
+
+def test_build_traffic_flight_record_weights():
+    s = small_schedule()
+    weights = [3, 0, 1, 2]  # shorter than n_rounds: padded with zeros
+    ops = build_traffic(s, seed=0, writes_per_round=weights)
+    per_round = [0] * s.n_rounds
+    for op in ops:
+        per_round[op.round] += 1
+    assert per_round[:4] == weights and sum(per_round[4:]) == 0
+
+
+def test_serving_chaos_verdicts_deterministic():
+    plan = ServingFaultPlan(
+        seed=11, stall_ppm=300_000, disconnect_ppm=200_000, http_5xx_ppm=100_000
+    )
+    a = [
+        ServingChaos(plan).stream_verdict(r, s)
+        for r in range(6)
+        for s in range(4)
+    ]
+    b = [
+        ServingChaos(plan).stream_verdict(r, s)
+        for r in range(6)
+        for s in range(4)
+    ]
+    assert a == b
+    assert any(v == "stall" for v in a)
+    http = [ServingChaos(plan).http_verdict(r, 0) for r in range(40)]
+    assert http == [ServingChaos(plan).http_verdict(r, 0) for r in range(40)]
+    assert any(http)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end replay: determinism + invariants under eviction pressure
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_replay_deterministic_digest_and_zero_violations(tmp_path):
+    s = small_schedule()
+    params = LoadgenParams(
+        n_subscribers=3,
+        n_pg_readers=1,
+        seed=2,
+        writes_per_round=2,
+        queue_size=8,  # small: forces evictions + reconnect catch-up
+        stalled_subscribers=1,
+    )
+
+    async def once(sub_dir):
+        return await replay(s, params, str(tmp_path / sub_dir))
+
+    r1 = run(once("a"))
+    r2 = run(once("b"))
+    assert r1.violations == []
+    assert r2.violations == []
+    assert r1.schedule_digest == r2.schedule_digest
+    assert r1.invariant_digest == r2.invariant_digest
+    assert r1.writes == 2 * s.n_rounds
+    # the stalled subscriber overflowed and the policy fired
+    assert r1.evicted >= 1 and r1.lagged >= 1
+    assert r1.stalled_queue_peak <= 8
+
+
+@pytest.mark.slow
+def test_serve_bench_json_exposes_policy_counters(tmp_path, monkeypatch):
+    # shrink the acceptance schedule so the bench leg stays test-sized —
+    # but keep writes above the bench queue bound (32) so the stalled
+    # subscriber actually overflows
+    monkeypatch.setattr(
+        loadgen,
+        "acceptance_schedule",
+        lambda seed=3: small_schedule(n_rounds=24),
+    )
+    out = loadgen.run_serve_bench(seed=0, subs_path=str(tmp_path / "subs"))
+    assert out["metric"] == "serve_replay"
+    assert out["violations"] == 0
+    for key in ("lagged", "evicted", "reconnects", "lag_p50", "lag_p99",
+                "matcher_throughput", "invariant_digest"):
+        assert key in out
+    assert out["evicted"] >= 1  # the artificially stalled subscriber

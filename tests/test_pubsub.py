@@ -227,6 +227,32 @@ def test_matcher_left_join_null_extension(tmp_path):
     run(main())
 
 
+def test_two_matcher_creates_share_a_pooled_connection(tmp_path):
+    """Regression: ``referenced_tables`` clears its authorizer when done.
+    On py3.10 ``set_authorizer(None)`` installs a deny-all hook instead of
+    clearing (bpo-44491), so the SECOND create on the same pooled read
+    connection died with ``sqlite3.DatabaseError: not authorized``."""
+
+    async def main():
+        agent = Agent(AgentConfig(db_path=":memory:", read_conns=1)).open_sync()
+        await agent.pool.write_call(lambda c: apply_schema(c, SCHEMA))
+        subs = SubsManager(str(tmp_path / "subs"), agent.pool)
+        subs.start()
+        m1, _ = await subs.get_or_insert("SELECT id, text FROM tests")
+        m2, _ = await subs.get_or_insert("SELECT id, buddy FROM buddies")
+        await asyncio.wait_for(m1.ready.wait(), 5)
+        await asyncio.wait_for(m2.ready.wait(), 5)
+        # the shared read connection must still serve plain queries
+        rows = await agent.pool.read_call(
+            lambda c: c.execute("SELECT count(*) FROM tests").fetchone()
+        )
+        assert rows == (0,)
+        await subs.stop()
+        agent.close()
+
+    run(main())
+
+
 def test_matcher_rejects_non_crr(tmp_path):
     async def main():
         agent, subs = await boot(tmp_path)

@@ -335,6 +335,57 @@ def run_fleet_bench(seed: int, scale: float, dev, cache_dir: str,
     }
 
 
+def run_mesh_dryrun_bench() -> dict:
+    """The mesh dryrun BENCH leg: execute the full simulation step on the
+    8-device virtual 2-D mesh, then run the GL5xx/GL6xx semantic tier and
+    stamp its per-entry comm model.  The headline numbers are the GL503
+    pair for the 1024-node dense mesh entry — modeled per-round
+    collective bytes against the gossip frame budget (sim/frames.py)."""
+    import __graft_entry__ as graft
+    from corrosion_tpu.analysis import lint_semantic
+    from corrosion_tpu.analysis.report import severity_counts
+
+    t0 = time.perf_counter()
+    graft.dryrun_multichip(8)
+    dryrun_s = time.perf_counter() - t0
+
+    t1 = time.perf_counter()
+    findings, summary = lint_semantic()
+    lint_s = time.perf_counter() - t1
+
+    mesh_entries = {
+        name: info
+        for name, info in summary.get("entries", {}).items()
+        if "@mesh" in name
+    }
+    dense = mesh_entries.get("sim.run_loop@mesh4x2[dense-n1024]", {})
+    counts = severity_counts(findings)
+    return {
+        "bench": "mesh_dryrun",
+        "mesh": {"nodes": 4, "changes": 2},
+        "n_nodes": 1024,
+        "dryrun_s": round(dryrun_s, 3),
+        "lint_semantic": {
+            "wall_s": round(lint_s, 3),
+            "errors": counts.get("error", 0),
+            "warnings": counts.get("warning", 0),
+            "entries_checked": len(summary.get("entries", {})),
+            "rng_tags": summary.get("rng_tags", {}),
+        },
+        "comm_bytes_per_round": dense.get("per_round_collective_bytes"),
+        "frame_bytes_per_round": dense.get("frame_bytes_per_round"),
+        "comm_by_entry": {
+            name: {
+                "per_round_collective_bytes": info.get(
+                    "per_round_collective_bytes"
+                ),
+                "loop_collectives": info.get("loop_collectives"),
+            }
+            for name, info in mesh_entries.items()
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -388,7 +439,21 @@ def main() -> None:
         help="QPS multiplier for --serve write pacing (x200 writes/s; "
         "<= 0 replays flat out)",
     )
+    ap.add_argument(
+        "--mesh-dryrun",
+        action="store_true",
+        help="run the 8-device 2-D-mesh dryrun leg instead: execute the "
+        "full step under GSPMD sharding (__graft_entry__.dryrun_multichip) "
+        "and stamp the semantic-lint summary + the GL503 per-round "
+        "collective-bytes model for the 1024-node mesh entry points "
+        "(analysis/semantic.py)",
+    )
     args = ap.parse_args()
+
+    if args.mesh_dryrun:
+        out = run_mesh_dryrun_bench()
+        print(json.dumps(out), flush=True)
+        return
 
     if args.serve:
         # pure-CPU asyncio leg: no device, no compile cache — keep JAX out

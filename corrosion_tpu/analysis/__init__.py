@@ -7,9 +7,13 @@ Three cooperating passes (see doc/lint.md for the rule catalogue):
 3. abstract shape/dtype contracts (GL3xx) via ``jax.eval_shape``
 4. buffer donation (GL4xx) over the device-program dirs (``sim/``,
    ``crdt/``, ``fleet/``)
+5. jaxpr/partitioned-HLO semantics (GL5xx sharding & communication,
+   GL6xx determinism) over the registered entry points — opt-in via
+   ``lint --semantic`` since it compiles the mesh programs
 
-Entry point: ``python -m corrosion_tpu.cli lint [--json] [--fail-on=...]``
-or :func:`lint_repo` / :func:`lint_paths` from code.
+Entry point: ``python -m corrosion_tpu.cli lint [--json] [--fail-on=...]
+[--semantic]`` or :func:`lint_repo` / :func:`lint_paths` /
+:func:`lint_semantic` from code.
 """
 
 from __future__ import annotations
@@ -78,11 +82,14 @@ def lint_paths(paths: Sequence[str], repo_root: Optional[str] = None) -> List[Fi
 
 
 def lint_repo(
-    repo_root: Optional[str] = None, with_contracts: bool = True
+    repo_root: Optional[str] = None,
+    with_contracts: bool = True,
+    with_semantic: bool = False,
 ) -> List[Finding]:
     """The full pass: AST lints over their scoped dirs + the eval_shape
     contract checks.  This is what ``cli lint`` and the agent's
-    ``--self-check`` run."""
+    ``--self-check`` run.  ``with_semantic`` adds the GL5xx/GL6xx tier
+    (compiles the mesh entry points — seconds, not milliseconds)."""
     root = repo_root or os.path.dirname(_PKG_ROOT)
     findings: List[Finding] = []
     walked = tuple(
@@ -92,7 +99,38 @@ def lint_repo(
         findings.extend(lint_file(path, root))
     if with_contracts:
         findings.extend(contracts.check_transition())
+    if with_semantic:
+        findings.extend(lint_semantic(repo_root=root)[0])
     return sort_findings(findings)
+
+
+def lint_semantic(
+    repo_root: Optional[str] = None, include_mesh: bool = True
+):
+    """GL5xx/GL6xx tier with the shared suppression plumbing applied:
+    a ``# graftlint: disable=GL501 (reason)`` on the provenance line
+    silences a semantic finding exactly like the AST tiers.  Returns
+    ``(findings, summary)``; the summary carries per-entry comm-bytes
+    for the BENCH stamp."""
+    from . import semantic
+
+    root = repo_root or os.path.dirname(_PKG_ROOT)
+    raw, summary = semantic.lint_semantic(include_mesh=include_mesh)
+    findings: List[Finding] = []
+    by_path: dict = {}
+    for f in raw:
+        by_path.setdefault(f.path, []).append(f)
+    for rel, group in sorted(by_path.items()):
+        abspath = os.path.join(root, rel)
+        try:
+            with open(abspath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            findings.extend(group)
+            continue
+        sups, _meta = scan_suppressions(rel, source)
+        findings.extend(apply_suppressions(group, sups))
+    return sort_findings(findings), summary
 
 
 __all__ = [
@@ -101,6 +139,7 @@ __all__ = [
     "lint_file",
     "lint_paths",
     "lint_repo",
+    "lint_semantic",
     "render_text",
     "render_json",
     "severity_counts",

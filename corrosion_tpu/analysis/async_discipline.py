@@ -28,7 +28,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from .rules import Finding, GL201, GL202, GL203, GL204
+from .rules import Finding, GL201, GL202, GL203, GL204, GL205
 
 _LOCKISH_NAME_PARTS = ("lock", "sem", "cond", "mutex")
 _RWLOCK_METHODS = {"read", "write"}
@@ -122,6 +122,9 @@ class _AsyncFuncChecker(ast.NodeVisitor):
         self.checker = checker
         self.lock_stack: List[str] = []
         self.findings: List[Finding] = []
+        # GL205: task expressions `.cancel()`ed earlier in this function
+        # (unparsed receiver -> line of the cancel call)
+        self.cancelled: Dict[str, int] = {}
 
     def _emit(self, rule, node, message):
         self.findings.append(
@@ -153,9 +156,40 @@ class _AsyncFuncChecker(ast.NodeVisitor):
         for _ in lock_items:
             self.lock_stack.pop()
 
+    def _gl205_target(self, node: ast.Await) -> Optional[str]:
+        """The cancelled-task expression this await consumes, if any.
+
+        Matches the two unsafe shapes: ``await task`` and
+        ``await asyncio.wait_for(task, ...)``.  ``cancel_and_wait(task)``
+        is a different callee, so the sanctioned helper never matches."""
+        v = node.value
+        if isinstance(v, (ast.Name, ast.Attribute)):
+            return ast.unparse(v)
+        if isinstance(v, ast.Call) and _func_name(v.func) == "wait_for":
+            if v.args and isinstance(v.args[0], (ast.Name, ast.Attribute)):
+                return ast.unparse(v.args[0])
+        return None
+
     def visit_Await(self, node: ast.Await):
         call = node.value if isinstance(node.value, ast.Call) else None
         fname = _func_name(call.func) if call else None
+
+        # GL205: awaiting a task this function already cancelled, without
+        # going through utils.aio.cancel_and_wait.  The bare await
+        # re-raises CancelledError into the canceller (or, under
+        # wait_for, can mask the cancel with a TimeoutError), and on
+        # 3.10 a task cancelled while *this* coroutine is also being
+        # cancelled swallows the outer cancellation (GH-86296).
+        key = self._gl205_target(node)
+        if key is not None and key in self.cancelled:
+            self._emit(
+                GL205,
+                node,
+                f"await of {key!r} after {key}.cancel() (line "
+                f"{self.cancelled[key]}) — use "
+                "utils.aio.cancel_and_wait, which shields the await and "
+                "distinguishes our cancel from an external one",
+            )
 
         # GL201: blocking network/sleep await while a lock is held.
         if self.lock_stack and fname in _BLOCKING_CALL_NAMES:
@@ -184,8 +218,18 @@ class _AsyncFuncChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Expr(self, node: ast.Expr):
-        # GL204: bare `asyncio.create_task(...)` as a statement.
+        # GL205 bookkeeping: `<task>.cancel()` as a statement.
         v = node.value
+        if (
+            isinstance(v, ast.Call)
+            and isinstance(v.func, ast.Attribute)
+            and v.func.attr == "cancel"
+            and isinstance(v.func.value, (ast.Name, ast.Attribute))
+        ):
+            self.cancelled.setdefault(
+                ast.unparse(v.func.value), node.lineno
+            )
+        # GL204: bare `asyncio.create_task(...)` as a statement.
         if (
             isinstance(v, ast.Call)
             and _func_name(v.func) == "create_task"

@@ -1,0 +1,222 @@
+"""Collective-communication model over partitioned HLO text (GL5xx).
+
+The SPMD partitioner only materializes collectives in the *optimized*
+HLO (``lowered.compile().as_text()``) — the pre-partitioning StableHLO
+carries sharding annotations but zero communication ops, so this module
+works on the compiled text, where every collective also carries
+``metadata={... source_file="…" source_line=N}`` provenance back to the
+``sim/`` line that produced it.
+
+Three things are extracted:
+
+- every collective instruction (kind, byte estimate from the result
+  shape, owning computation, provenance);
+- the call graph between computations, so collectives can be attributed
+  to ``while``-loop bodies (those run per gossip round — the ones the
+  GL503 frame-budget check cares about);
+- per-kind byte totals for the BENCH comm-bytes stamp.
+
+The byte estimate is deliberately simple: the serialized size of the
+instruction's result shape(s).  For all-reduce that is the per-device
+tensor size (each device sends+receives one copy under ring reduction);
+for all-gather it is the gathered output, an upper bound on what any
+device receives.  The model only needs to be accurate enough to compare
+against the per-round gossip frame budget (sim/frames.py) at one order
+of magnitude.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Collective op kinds the partitioner can insert.  ``-start`` async
+# halves carry the shape; ``-done`` halves are skipped to avoid double
+# counting.
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+    "reduce-scatter",
+    "collective-broadcast",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1,
+    "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([\d,]*)\]")
+_COLLECTIVE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?P<result>.*?)\s+"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?P<async>-start|-done)?\(",
+)
+# Computation headers sit at column 0 and end with "{"; the param list
+# can nest parens (tuple-typed loop carries), so only the leading name is
+# parsed and the structure is checked on the line itself.
+_COMP_HEADER_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(")
+_CALLEE_RE = re.compile(
+    r"(?:to_apply|body|condition|calls)=%?([\w.\-]+)"
+)
+_BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+_WHILE_RE = re.compile(r"\bwhile\(")
+_META_FILE_RE = re.compile(r'source_file="([^"]*)"')
+_META_LINE_RE = re.compile(r"source_line=(\d+)")
+_META_OP_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def shape_bytes(text: str) -> int:
+    """Sum serialized bytes of every ``dtype[dims]`` shape in ``text``."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue  # token[], opaque[] etc. carry no payload
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * size
+    return total
+
+
+@dataclass(frozen=True)
+class Collective:
+    kind: str
+    bytes: int
+    computation: str
+    op_name: str
+    source_file: str
+    source_line: int
+    in_loop_body: bool
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "bytes": self.bytes,
+            "computation": self.computation,
+            "op_name": self.op_name,
+            "source_file": self.source_file,
+            "source_line": self.source_line,
+            "in_loop_body": self.in_loop_body,
+        }
+
+
+@dataclass
+class HloModel:
+    """Parsed view of one optimized HLO module."""
+
+    collectives: List[Collective]
+    loop_bodies: Set[str]          # computations reachable from a while body
+    computations: Dict[str, List[str]]
+
+    def loop_collectives(self) -> List[Collective]:
+        return [c for c in self.collectives if c.in_loop_body]
+
+    def bytes_by_kind(self, loop_only: bool = False) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for c in self.collectives:
+            if loop_only and not c.in_loop_body:
+                continue
+            out[c.kind] = out.get(c.kind, 0) + c.bytes
+        return out
+
+    def per_round_bytes(self) -> int:
+        """Bytes every loop iteration moves across the mesh."""
+        return sum(c.bytes for c in self.collectives if c.in_loop_body)
+
+
+def _split_computations(hlo_text: str) -> Dict[str, List[str]]:
+    comps: Dict[str, List[str]] = {}
+    current: Optional[str] = None
+    for line in hlo_text.splitlines():
+        if current is None:
+            if line[:1].isspace() or not line.rstrip().endswith("{"):
+                continue
+            m = _COMP_HEADER_RE.match(line)
+            if m:
+                current = m.group(2)
+                comps[current] = []
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        comps[current].append(line)
+    return comps
+
+
+def _callees(lines: Iterable[str]) -> Set[str]:
+    out: Set[str] = set()
+    for line in lines:
+        out.update(_CALLEE_RE.findall(line))
+        for grp in _BRANCHES_RE.findall(line):
+            for name in grp.split(","):
+                name = name.strip().lstrip("%")
+                if name:
+                    out.add(name)
+    return out
+
+
+def _reachable(
+    roots: Sequence[str], edges: Dict[str, Set[str]]
+) -> Set[str]:
+    seen: Set[str] = set()
+    stack = list(roots)
+    while stack:
+        name = stack.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        stack.extend(edges.get(name, ()))
+    return seen
+
+
+def parse_hlo(hlo_text: str) -> HloModel:
+    comps = _split_computations(hlo_text)
+    edges = {name: _callees(lines) for name, lines in comps.items()}
+
+    # while bodies (and conditions): everything reachable from them runs
+    # once per loop iteration.
+    loop_roots: List[str] = []
+    for lines in comps.values():
+        for line in lines:
+            if _WHILE_RE.search(line):
+                for key in ("condition", "body"):
+                    m = re.search(key + r"=%?([\w.\-]+)", line)
+                    if m:
+                        loop_roots.append(m.group(1))
+    loop_bodies = _reachable(loop_roots, edges)
+
+    collectives: List[Collective] = []
+    for comp, lines in comps.items():
+        for line in lines:
+            m = _COLLECTIVE_RE.match(line)
+            if not m:
+                continue
+            if m.group("async") == "-done":
+                continue
+            fmeta = _META_FILE_RE.search(line)
+            lmeta = _META_LINE_RE.search(line)
+            ometa = _META_OP_RE.search(line)
+            collectives.append(
+                Collective(
+                    kind=m.group("kind"),
+                    bytes=shape_bytes(m.group("result")),
+                    computation=comp,
+                    op_name=ometa.group(1) if ometa else "",
+                    source_file=fmeta.group(1) if fmeta else "",
+                    source_line=int(lmeta.group(1)) if lmeta else 0,
+                    in_loop_body=comp in loop_bodies,
+                )
+            )
+    return HloModel(
+        collectives=collectives,
+        loop_bodies=loop_bodies,
+        computations=comps,
+    )

@@ -1,0 +1,256 @@
+"""GL601 — counter-RNG tag audit (determinism tier).
+
+The simulator's entire determinism story routes through the splittable
+counter RNG in :mod:`corrosion_tpu.sim.rng`: every random decision is
+``hash(seed, TAG, *fields)``, and independence between decision families
+holds exactly as long as the ``TAG_*`` namespace stays disjoint.  This
+pass harvests the namespace statically:
+
+- **definitions** — module-level ``TAG_X = <int>`` assignments;
+- **draw sites** — calls to the rng entry points (``py_hash``,
+  ``py_below``, ``jx_hash``, ``jx_below``) whose arguments mention a
+  ``TAG_*`` name.
+
+and checks two invariants:
+
+- two distinct tag names sharing one value (or one name re-defined with
+  a different value) is an **error** — the streams collide and every
+  independence assumption in the fidelity proofs silently fails;
+- one tag drawn from two different subsystems (top-level package dirs:
+  ``sim``, ``chaos``, ``harness``, …) is a **warning** unless the pair
+  is in :data:`PAIRED_TAGS` — the oracle twins (``sim/reference.py``
+  replayed by ``chaos/pairing.py`` etc.) *must* share draws to pair
+  event-for-event, and those tags are allowlisted by name.
+
+The harvested registry is also what ``doc/lint.md`` documents and what
+``tests/test_lint_semantic.py`` pins, so a new tag shows up here first.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from .rules import ERROR, WARNING, Finding
+
+# rng entry points whose call sites constitute a "draw" of the tag they
+# mention (sim/rng.py; the jx_* twins are the traced forms).
+DRAW_FUNCS = frozenset(
+    {"py_hash", "py_below", "py_mix", "jx_hash", "jx_below", "jx_mix"}
+)
+
+# Tags deliberately shared across subsystem boundaries: the chaos
+# pairing/compare oracles re-issue the sim's exact draws so that chaos
+# events pair 1:1 with simulator events (chaos/pairing.py docstring).
+# Sharing is the point — flagging it would force a suppression at every
+# oracle call site.
+PAIRED_TAGS = frozenset(
+    {"TAG_SYNC", "TAG_BCAST", "TAG_ORIGIN", "TAG_PART", "TAG_CHURN",
+     "TAG_CHAOS_DROP", "TAG_CHAOS_DUP"}
+)
+
+# Directories under the package root that participate in the audit.
+AUDIT_DIRS = ("sim", "chaos", "harness")
+
+
+@dataclass(frozen=True)
+class TagDef:
+    name: str
+    value: int
+    path: str
+    line: int
+
+
+@dataclass(frozen=True)
+class TagDraw:
+    name: str
+    path: str
+    line: int
+    subsystem: str
+
+
+@dataclass
+class TagRegistry:
+    """Everything the audit learned about the TAG_* namespace."""
+
+    defs: List[TagDef] = field(default_factory=list)
+    draws: List[TagDraw] = field(default_factory=list)
+
+    def by_value(self) -> Dict[int, List[TagDef]]:
+        out: Dict[int, List[TagDef]] = {}
+        for d in self.defs:
+            out.setdefault(d.value, []).append(d)
+        return out
+
+    def draw_subsystems(self) -> Dict[str, List[TagDraw]]:
+        out: Dict[str, List[TagDraw]] = {}
+        for d in self.draws:
+            out.setdefault(d.name, []).append(d)
+        return out
+
+
+def _subsystem(path: Path, roots: Sequence[Path]) -> str:
+    """First path segment below the nearest scan root — 'sim', 'chaos', …"""
+    for root in roots:
+        try:
+            rel = path.resolve().relative_to(root.resolve())
+        except ValueError:
+            continue
+        if len(rel.parts) > 1:
+            return rel.parts[0]
+        return root.name
+    return path.parent.name
+
+
+class _Harvester(ast.NodeVisitor):
+    def __init__(self, path: str, subsystem: str, reg: TagRegistry):
+        self.path = path
+        self.subsystem = subsystem
+        self.reg = reg
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # module-level `TAG_X = <int literal>`; nested defs don't count
+        # as namespace entries (they'd shadow, which GL601 would flag
+        # anyway once drawn).
+        if (
+            len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id.startswith("TAG_")
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, int)
+        ):
+            self.reg.defs.append(
+                TagDef(
+                    name=node.targets[0].id,
+                    value=node.value.value,
+                    path=self.path,
+                    line=node.lineno,
+                )
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Name):
+            name = fn.id
+        elif isinstance(fn, ast.Attribute):
+            name = fn.attr
+        if name in DRAW_FUNCS:
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id.startswith(
+                        "TAG_"
+                    ):
+                        self.reg.draws.append(
+                            TagDraw(
+                                name=sub.id,
+                                path=self.path,
+                                line=node.lineno,
+                                subsystem=self.subsystem,
+                            )
+                        )
+        self.generic_visit(node)
+
+
+def harvest(paths: Iterable[Path], roots: Sequence[Path]) -> TagRegistry:
+    reg = TagRegistry()
+    for path in sorted(set(paths)):
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+        except (OSError, SyntaxError):
+            continue
+        _Harvester(str(path), _subsystem(path, roots), reg).visit(tree)
+    return reg
+
+
+def harvest_repo(package_root) -> TagRegistry:
+    """Harvest the standard audit surface: sim/, chaos/, harness/."""
+    package_root = Path(package_root)
+    roots = [package_root]
+    files: List[Path] = []
+    for sub in AUDIT_DIRS:
+        d = package_root / sub
+        if d.is_dir():
+            files.extend(sorted(d.rglob("*.py")))
+    return harvest(files, roots)
+
+
+def check_registry(reg: TagRegistry) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # -- collisions: one value, two names / one name, two values ----------
+    for value, defs in sorted(reg.by_value().items()):
+        names = sorted({d.name for d in defs})
+        if len(names) > 1:
+            for d in defs:
+                others = ", ".join(n for n in names if n != d.name)
+                findings.append(
+                    Finding(
+                        path=d.path,
+                        line=d.line,
+                        rule="GL601",
+                        severity=ERROR,
+                        message=(
+                            f"{d.name} = {value} collides with {others} "
+                            f"(same counter value): the streams are "
+                            f"identical, not independent"
+                        ),
+                    )
+                )
+    by_name: Dict[str, List[TagDef]] = {}
+    for d in reg.defs:
+        by_name.setdefault(d.name, []).append(d)
+    for name, defs in sorted(by_name.items()):
+        values = sorted({d.value for d in defs})
+        if len(values) > 1:
+            for d in defs:
+                findings.append(
+                    Finding(
+                        path=d.path,
+                        line=d.line,
+                        rule="GL601",
+                        severity=ERROR,
+                        message=(
+                            f"{name} defined with conflicting values "
+                            f"{values}: draws keyed on the name sample "
+                            f"different streams per importer"
+                        ),
+                    )
+                )
+
+    # -- cross-subsystem reuse -------------------------------------------
+    for name, draws in sorted(reg.draw_subsystems().items()):
+        if name in PAIRED_TAGS:
+            continue
+        subsystems = sorted({d.subsystem for d in draws})
+        if len(subsystems) > 1:
+            first = draws[0]
+            for d in draws:
+                if d.subsystem == first.subsystem:
+                    continue
+                findings.append(
+                    Finding(
+                        path=d.path,
+                        line=d.line,
+                        rule="GL601",
+                        severity=WARNING,
+                        message=(
+                            f"{name} drawn from subsystem "
+                            f"'{d.subsystem}' and '{first.subsystem}' "
+                            f"({first.path}:{first.line}) but is not a "
+                            f"paired oracle tag — unrelated draws on "
+                            f"one stream correlate decisions"
+                        ),
+                    )
+                )
+
+    return findings
+
+
+def audit_tags(package_root: Path) -> Tuple[TagRegistry, List[Finding]]:
+    reg = harvest_repo(package_root)
+    return reg, check_registry(reg)

@@ -9,6 +9,11 @@ is one located violation.  Rule IDs are grouped by pass:
   transport/)
 - ``GL3xx`` — abstract shape/dtype contracts (jax.eval_shape over the
   sim transition)
+- ``GL4xx`` — buffer donation on hot-path jit entry points
+- ``GL5xx`` — jaxpr/HLO semantic analysis: sharding & communication of
+  the partitioned entry points (analysis/semantic.py)
+- ``GL6xx`` — determinism: counter-RNG tag audit and non-deterministic
+  primitives inside compiled loops
 
 Severities: ``error`` findings break the fidelity/correctness contracts
 named in each rule's rationale (doc/lint.md) and fail the build under the
@@ -178,6 +183,18 @@ GL204 = _rule(
     "and cannot be cancelled at shutdown — every task in agent/node.py "
     "is tracked in _tasks for exactly this reason.",
 )
+GL205 = _rule(
+    "GL205",
+    ERROR,
+    "task.cancel() followed by a bare await instead of cancel_and_wait",
+    "On py3.10, `asyncio.wait_for` swallows a cancellation that lands "
+    "the same tick its inner future completes (GH-86296), so a single "
+    "`t.cancel()` + `await t` can wait forever while the task keeps "
+    "running — and a cancel() with NO await at all leaves the task "
+    "executing past the point its owner thinks it stopped.  Use "
+    "utils/aio.cancel_and_wait, which re-issues the cancel until the "
+    "task actually exits.",
+)
 
 # -- abstract contracts -------------------------------------------------------
 
@@ -222,6 +239,76 @@ GL401 = _rule(
     "Suppress with a reason where donation is genuinely wrong: the "
     "caller reuses the input buffer across calls (bandwidth probes, "
     "profiling reps) or the output must not alias the input.",
+)
+
+
+# -- jaxpr/HLO semantic analysis ----------------------------------------------
+
+GL501 = _rule(
+    "GL501",
+    ERROR,
+    "unexpected collective on the 'nodes'/'changes' mesh axes",
+    "The partitioned sim is designed so that the only cross-device "
+    "traffic is the gossip exchange itself (reductions over coverage "
+    "and the neighbour permute) — an all-gather/all-to-all/reshard that "
+    "the SPMD partitioner inserted anywhere else means a sharding "
+    "annotation is missing or wrong, and the op silently replicates a "
+    "state leaf across the mesh.  On the 100k-node configs that is "
+    "hundreds of MB per round of interconnect traffic the paper's "
+    "cost model never accounts for.  Each lintable entry point carries "
+    "an allowlist of (source file, collective kind) pairs; anything "
+    "outside it fires, with the HLO op's source provenance.",
+)
+GL502 = _rule(
+    "GL502",
+    ERROR,
+    "loop-carry sharding instability (carry resharded across rounds)",
+    "lax.while_loop/scan carries must come back with the sharding they "
+    "went in with; if a body op forces a different layout the "
+    "partitioner inserts a reshard *every round* — O(rounds) collective "
+    "traffic instead of O(1) — and the compiled loop no longer matches "
+    "the per-round comm model (sim/frames.py).  Detected by comparing "
+    "the declared entry shardings against the sharding of the "
+    "corresponding loop outputs in the partitioned HLO.",
+)
+GL503 = _rule(
+    "GL503",
+    WARNING,
+    "modeled per-round collective bytes exceed the gossip frame budget",
+    "sim/frames.py derives the bytes-per-round each node may emit from "
+    "the frame schema; the collectives in the partitioned loop body "
+    "move a statically knowable number of bytes per round.  When the "
+    "collective traffic exceeds the modeled gossip payload by more "
+    "than the tolerated margin, the compiled program is moving state "
+    "the protocol model says it shouldn't — usually a replicated "
+    "operand being re-broadcast every round.",
+)
+
+# -- counter-RNG / determinism ------------------------------------------------
+
+GL601 = _rule(
+    "GL601",
+    ERROR,
+    "counter-RNG tag collision or cross-subsystem tag reuse",
+    "The sim's determinism rests on sim/rng.py counter streams being "
+    "disjoint per draw site: two TAG_* constants with the same value, "
+    "or one tag drawn from two unrelated subsystems, correlate streams "
+    "that every proof of independence assumes are independent — runs "
+    "stay reproducible but sample a subtly wrong distribution.  Tags "
+    "deliberately shared with an oracle twin (sim/reference.py, "
+    "chaos/pairing.py) are allowlisted as paired.",
+)
+GL602 = _rule(
+    "GL602",
+    ERROR,
+    "non-deterministic primitive inside a scan/while body",
+    "A host callback, unseeded PRNG primitive, or wall-clock read "
+    "inside a lax.scan/while_loop body executes per round on device "
+    "with no counter-RNG discipline — the run is no longer a pure "
+    "function of (params, seed), so the CPU-reference fidelity bar and "
+    "chaos-pairing replay both silently break.  All randomness must "
+    "route through sim/rng.py counter streams; all host I/O must stay "
+    "outside the compiled region.",
 )
 
 

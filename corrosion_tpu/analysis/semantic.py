@@ -1,0 +1,679 @@
+"""GL5xx/GL6xx — jaxpr & partitioned-HLO semantic analysis.
+
+Where the GL1xx/GL2xx passes read *source*, this tier reads the
+*compiled artifact*: every lintable entry point (the convergence
+while_loop, the flight-recorder scan, the fleet ``jit(vmap(lane))`` and
+the 2-D-mesh variants of the loop) is lowered under abstract arguments —
+the exact jits production builds, via ``cluster.build_solo_fn`` /
+``build_mesh_fn`` / ``flight.build_scan_fn`` / ``fleet.build_fleet_fn``
+— and three families of invariants are checked:
+
+- **GL501/GL502/GL503** (mesh entries): collectives only materialize
+  after SPMD partitioning, so the mesh entries are *compiled* (cheap at
+  the 1024-node lint scale, ~seconds each) and the optimized HLO is
+  walked with :mod:`.comm_model`.  GL501 flags collectives whose
+  ``source_file`` provenance isn't in the entry's allowlist; GL502 flags
+  carry-sharding instability (a reshard inside the loop body, or the
+  carry settling on a different sharding than declared); GL503
+  cross-checks the per-round collective bytes against the gossip frame
+  budget from ``sim/frames.py``.
+- **GL602** (all entries): the ClosedJaxpr is walked recursively and any
+  host-callback / unseeded-PRNG primitive inside a ``scan``/``while``
+  body is flagged with jaxpr ``source_info`` provenance.
+- **GL601** rides along from :mod:`.rng_audit` (pure AST, no jax).
+
+Device provisioning: the mesh entries need ≥8 devices (a 4×2
+'nodes'×'changes' mesh).  If the jax backend is not yet initialized the
+checker injects ``--xla_force_host_platform_device_count=8`` before
+first use; if some caller already latched a smaller backend, the whole
+pass re-runs itself in a subprocess (``python -m
+corrosion_tpu.analysis.semantic --json``) and adopts its findings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+
+from . import comm_model, rng_audit
+from .rules import ERROR, WARNING, Finding, sort_findings
+
+REQUIRED_DEVICES = 8
+MESH_SHAPE = (4, 2)
+MESH_AXES = ("nodes", "changes")
+
+# GL503: how many times the modeled gossip frame bytes the loop's
+# collectives may move per round before the entry is flagged.  The
+# collectives carry the coverage reductions and the neighbour exchange
+# itself, so some multiple of the frame payload is expected; an order of
+# magnitude past it means replicated state is being re-broadcast every
+# round.
+GL503_MARGIN = 8.0
+
+# Host-callback and unseeded-PRNG primitives (GL602).  The sim's own
+# randomness is counter-based integer hashing (sim/rng.py) and never
+# lowers to these.
+NONDET_PRIMITIVES = frozenset(
+    {
+        "pure_callback",
+        "io_callback",
+        "callback",
+        "debug_callback",
+        "threefry2x32",
+        "random_seed",
+        "random_bits",
+        "random_wrap",
+        "random_unwrap",
+        "random_fold_in",
+        "random_gamma",
+        "rng_bit_generator",
+    }
+)
+
+_LOOP_PRIMITIVES = frozenset({"while", "scan"})
+
+# GL501 allowlist shared by the sim entry points: collectives whose
+# provenance lands in these files are the partitioned gossip exchange
+# itself.  Anything else — another repo file, a test fixture, an
+# unexpected kind (all-to-all, full reshard) — fires.
+SIM_COLLECTIVE_ALLOW: Dict[str, FrozenSet[str]] = {
+    "corrosion_tpu/sim/cluster.py": frozenset(
+        {"all-reduce", "all-gather", "collective-permute", "reduce-scatter"}
+    ),
+    "corrosion_tpu/sim/sync.py": frozenset(
+        {"all-reduce", "all-gather", "collective-permute", "reduce-scatter"}
+    ),
+    "corrosion_tpu/sim/frames.py": frozenset(
+        {"all-reduce", "all-gather", "collective-permute", "reduce-scatter"}
+    ),
+    "corrosion_tpu/sim/crdt.py": frozenset(
+        {"all-reduce", "all-gather", "collective-permute", "reduce-scatter"}
+    ),
+    "corrosion_tpu/sim/pack.py": frozenset(
+        {"all-reduce", "all-gather", "collective-permute", "reduce-scatter"}
+    ),
+    # compiler-synthesized ops with no user frame (loop plumbing,
+    # convergence predicate reductions)
+    "": frozenset({"all-reduce", "all-gather", "collective-permute"}),
+}
+
+
+@dataclass
+class EntrySpec:
+    """One lintable entry point.
+
+    ``build(jax)`` returns ``(fn, args)`` where ``fn`` is the jitted
+    callable and ``args`` the abstract arguments to lower it with.
+    ``mesh=True`` entries are compiled and HLO-checked (GL501/502/503);
+    all entries get the jaxpr walk (GL602)."""
+
+    name: str
+    path: str                      # repo-relative provenance anchor
+    build: Callable[[Any], Tuple[Any, tuple]]
+    mesh: bool = False
+    allow: Dict[str, FrozenSet[str]] = field(
+        default_factory=lambda: dict(SIM_COLLECTIVE_ALLOW)
+    )
+    p: Any = None                  # SimParams for the frame-budget model
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _rel(path: str) -> str:
+    root = _repo_root() + os.sep
+    if path.startswith(root):
+        return path[len(root):].replace(os.sep, "/")
+    return path
+
+
+# -- device provisioning ------------------------------------------------------
+
+
+def _backend_initialized() -> bool:
+    xb = sys.modules.get("jax._src.xla_bridge")
+    return bool(getattr(xb, "_backends", None)) if xb is not None else False
+
+
+def _provision_env(env: Dict[str, str]) -> Dict[str, str]:
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={REQUIRED_DEVICES}"
+        ).strip()
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def _can_run_in_process() -> bool:
+    """True when this process can lower the mesh entries itself."""
+    if not _backend_initialized():
+        _provision_env(os.environ)
+        return True
+    import jax
+
+    return jax.device_count() >= REQUIRED_DEVICES
+
+
+# -- entry registry -----------------------------------------------------------
+
+
+def _state_avals(jax, cluster, p, batch=None):
+    if batch is None:
+        return jax.eval_shape(lambda: cluster.init_state(p))
+    return jax.eval_shape(lambda: cluster.init_state(p, batch=batch))
+
+
+def _chaos_plane_avals(jax, cluster, p):
+    """Abstract chaos plane stacks for ``p``.  The schedule derives from
+    a ppm-bearing twin (the plane stacks subsume the scalars, so the
+    entry's own params keep them zero — cluster asserts this)."""
+    from ..chaos.lower import lower as lower_chaos
+    from ..chaos.schedule import from_sim_params
+
+    src = dataclasses.replace(
+        p, partition_frac_ppm=250_000, churn_ppm=2_000
+    )
+    sched = from_sim_params(src)
+    lowered = lower_chaos(sched, horizon=p.max_rounds)
+    planes = cluster.chaos_operands(p, lowered)
+    return jax.eval_shape(lambda: planes)
+
+
+def _entries(include_mesh: bool = True) -> List[EntrySpec]:
+    """The registry.  Params derive from the BASELINE configs exactly
+    like the GL3xx contract probes (contracts._probe_params), so the
+    lint surface tracks the configs the paper reports."""
+    from ..sim import model
+    from .contracts import _probe_params
+
+    out: List[EntrySpec] = []
+
+    def solo_entry(label, p, chaos=False):
+        def build(jax):
+            from ..sim import cluster
+
+            fn = cluster.build_solo_fn(p, with_chaos=chaos, donate=False)
+            args = (_state_avals(jax, cluster, p),)
+            if chaos:
+                args = args + (_chaos_plane_avals(jax, cluster, p),)
+            return fn, args
+
+        out.append(
+            EntrySpec(
+                name=f"sim.run_loop[{label}]",
+                path="corrosion_tpu/sim/cluster.py",
+                build=build,
+                p=p,
+            )
+        )
+
+    # the GL3xx probe ladder: small / paper-scale / north-star scale
+    solo_entry("dense-n128", _probe_params(128))
+    solo_entry("dense-n10k", _probe_params(10_000))
+    p100k = _probe_params(100_000)
+    solo_entry("dense-n100k", p100k)
+    solo_entry(
+        "packed-framed-n100k",
+        dataclasses.replace(p100k, packed=True, framed=True),
+    )
+    solo_entry("chaos-n128", _probe_params(128), chaos=True)
+
+    # flight recorder scan
+    p_flight = _probe_params(128)
+
+    def build_flight(jax):
+        from ..sim import cluster, flight
+
+        fn = flight.build_scan_fn(
+            p_flight, length=p_flight.max_rounds, with_chaos=False
+        )
+        return fn, (_state_avals(jax, cluster, p_flight),)
+
+    out.append(
+        EntrySpec(
+            name="flight.record_run[dense-n128]",
+            path="corrosion_tpu/sim/flight.py",
+            build=build_flight,
+            p=p_flight,
+        )
+    )
+
+    # fleet jit(vmap(lane))
+    p_fleet = _probe_params(128)
+    B = 4
+
+    def build_fleet(jax):
+        import jax.numpy as jnp
+
+        from ..fleet import run as fleet_run
+        from ..sim import cluster
+
+        fn = fleet_run.build_fleet_fn(
+            p_fleet, R=p_fleet.max_rounds, with_chaos=False
+        )
+        kvs = (
+            jax.ShapeDtypeStruct((B,), jnp.uint32),   # seed
+            jax.ShapeDtypeStruct((B,), jnp.int32),    # fanout
+            jax.ShapeDtypeStruct((B,), jnp.int32),    # max_transmissions
+            jax.ShapeDtypeStruct((B,), jnp.int32),    # sync_interval
+            jax.ShapeDtypeStruct((B,), jnp.int32),    # write_rounds
+        )
+        return fn, (_state_avals(jax, cluster, p_fleet, batch=B), kvs)
+
+    out.append(
+        EntrySpec(
+            name=f"fleet.run_fleet[dense-n128-b{B}]",
+            path="corrosion_tpu/fleet/run.py",
+            build=build_fleet,
+            p=p_fleet,
+        )
+    )
+
+    if not include_mesh:
+        return out
+
+    # 2-D mesh variants: the 1024-node dryrun scale on a 4×2
+    # 'nodes'×'changes' mesh (the BENCH mesh-dryrun leg stamps the
+    # dense entry's comm bytes).
+    base = model.config2_er1k()
+    p_mesh = dataclasses.replace(base, n_nodes=1024)
+
+    def mesh_entry(label, p, chaos=False):
+        def build(jax):
+            from ..sim import cluster
+
+            mesh = _lint_mesh(jax)
+            shardings = cluster.state_shardings(
+                p, mesh, node_axis=MESH_AXES[0], change_axis=MESH_AXES[1]
+            )
+            fn = cluster.build_mesh_fn(
+                p,
+                shardings,
+                with_chaos=chaos,
+                donate=False,
+                declared_out=False,
+            )
+            args = (_state_avals(jax, cluster, p),)
+            if chaos:
+                args = args + (_chaos_plane_avals(jax, cluster, p),)
+            return fn, args
+
+        out.append(
+            EntrySpec(
+                name=f"sim.run_loop@mesh4x2[{label}]",
+                path="corrosion_tpu/sim/cluster.py",
+                build=build,
+                mesh=True,
+                p=p,
+            )
+        )
+
+    mesh_entry("dense-n1024", p_mesh)
+    mesh_entry(
+        "packed-framed-n1024",
+        dataclasses.replace(p_mesh, packed=True, framed=True),
+    )
+    mesh_entry("chaos-n1024", p_mesh, chaos=True)
+    return out
+
+
+def _lint_mesh(jax):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < REQUIRED_DEVICES:
+        raise RuntimeError(
+            f"semantic lint needs {REQUIRED_DEVICES} devices for the "
+            f"{MESH_SHAPE} mesh; have {len(devs)}"
+        )
+    return Mesh(
+        np.asarray(devs[:REQUIRED_DEVICES]).reshape(*MESH_SHAPE), MESH_AXES
+    )
+
+
+# -- GL602: jaxpr walk --------------------------------------------------------
+
+
+def _sub_jaxprs(eqn):
+    import jax.core as core
+
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vals:
+            if isinstance(x, core.ClosedJaxpr):
+                yield x.jaxpr
+            elif isinstance(x, core.Jaxpr):
+                yield x
+
+
+def _eqn_provenance(eqn, default_path: str) -> Tuple[str, int]:
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            return _rel(frame.file_name), int(frame.start_line)
+    except Exception:
+        pass
+    return default_path, 1
+
+
+def _walk_nondet(jaxpr, in_loop: bool, entry: EntrySpec, findings: List[Finding]):
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if in_loop and prim in NONDET_PRIMITIVES:
+            path, line = _eqn_provenance(eqn, entry.path)
+            findings.append(
+                Finding(
+                    path=path,
+                    line=line,
+                    rule="GL602",
+                    severity=ERROR,
+                    message=(
+                        f"{entry.name}: non-deterministic primitive "
+                        f"'{prim}' inside a compiled loop body — the run "
+                        f"is no longer a pure function of (params, seed)"
+                    ),
+                )
+            )
+        inner_loop = in_loop or prim in _LOOP_PRIMITIVES
+        for sub in _sub_jaxprs(eqn):
+            _walk_nondet(sub, inner_loop, entry, findings)
+
+
+def _check_nondet(jax, entry: EntrySpec, fn, args) -> List[Finding]:
+    findings: List[Finding] = []
+    closed = jax.make_jaxpr(fn)(*args)
+    _walk_nondet(closed.jaxpr, False, entry, findings)
+    return findings
+
+
+# -- GL501/502/503: partitioned-HLO checks ------------------------------------
+
+
+def _check_collectives(
+    entry: EntrySpec, model: comm_model.HloModel
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for c in model.collectives:
+        rel = _rel(c.source_file)
+        allowed: FrozenSet[str] = frozenset()
+        for suffix, kinds in entry.allow.items():
+            if suffix == "" and rel == "":
+                allowed = kinds
+                break
+            if suffix and rel.endswith(suffix):
+                allowed = kinds
+                break
+        if c.kind in allowed:
+            continue
+        path = rel or entry.path
+        findings.append(
+            Finding(
+                path=path,
+                line=c.source_line or 1,
+                rule="GL501",
+                severity=ERROR,
+                message=(
+                    f"{entry.name}: unexpected {c.kind} "
+                    f"({c.bytes} B, op {c.op_name or '?'}) inserted by "
+                    f"the partitioner outside the entry's allowlist"
+                ),
+            )
+        )
+    return findings
+
+
+def _check_carry_sharding(
+    jax, entry: EntrySpec, compiled, declared, model: comm_model.HloModel
+) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # (a) a sharding constraint lowered INTO the loop body is a reshard
+    # every round
+    for c in model.loop_collectives():
+        if "sharding_constraint" in (c.op_name or ""):
+            rel = _rel(c.source_file) or entry.path
+            findings.append(
+                Finding(
+                    path=rel,
+                    line=c.source_line or 1,
+                    rule="GL502",
+                    severity=ERROR,
+                    message=(
+                        f"{entry.name}: sharding constraint inside the "
+                        f"loop body forces a {c.kind} ({c.bytes} B) "
+                        f"every round — the carry is resharded "
+                        f"O(rounds) times instead of staying stable"
+                    ),
+                )
+            )
+
+    # (b) the carry must settle on the sharding it was declared with:
+    # compile with out_shardings unspecified and compare what
+    # propagation produced against the declared input shardings.
+    try:
+        out_shardings = jax.tree_util.tree_leaves(
+            compiled.output_shardings, is_leaf=lambda x: x is None
+        )
+    except Exception:
+        return findings
+    decl = list(declared)
+    if len(out_shardings) < len(decl):
+        return findings
+    for i, (want, got) in enumerate(zip(decl, out_shardings)):
+        if want is None or got is None:
+            continue
+        try:
+            spec_want = tuple(getattr(want, "spec", ()) or ())
+            spec_got = tuple(getattr(got, "spec", ()) or ())
+        except Exception:
+            continue
+
+        def _norm(spec):
+            t = tuple(spec)
+            while t and t[-1] is None:
+                t = t[:-1]
+            return t
+
+        if _norm(spec_want) != _norm(spec_got):
+            findings.append(
+                Finding(
+                    path=entry.path,
+                    line=1,
+                    rule="GL502",
+                    severity=ERROR,
+                    message=(
+                        f"{entry.name}: state leaf {i} enters the loop "
+                        f"sharded {spec_want} but settles on "
+                        f"{spec_got} — the partitioner reshards the "
+                        f"carry instead of keeping it stable"
+                    ),
+                )
+            )
+    return findings
+
+
+def _check_frame_budget(
+    entry: EntrySpec, model: comm_model.HloModel
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    from ..sim import frames
+
+    per_round = model.per_round_bytes()
+    budget = int(frames.frame_bytes_per_round(entry.p))
+    info = {
+        "per_round_collective_bytes": per_round,
+        "frame_bytes_per_round": budget,
+        "margin": GL503_MARGIN,
+    }
+    findings: List[Finding] = []
+    if budget > 0 and per_round > GL503_MARGIN * budget:
+        worst = max(
+            model.loop_collectives(), key=lambda c: c.bytes, default=None
+        )
+        path = _rel(worst.source_file) if worst and worst.source_file else entry.path
+        line = worst.source_line if worst else 1
+        findings.append(
+            Finding(
+                path=path or entry.path,
+                line=line or 1,
+                rule="GL503",
+                severity=WARNING,
+                message=(
+                    f"{entry.name}: loop collectives move {per_round} B "
+                    f"per round, > {GL503_MARGIN:g}x the modeled gossip "
+                    f"frame budget ({budget} B/round, sim/frames.py) — "
+                    f"the compiled program moves state the protocol "
+                    f"model doesn't account for"
+                ),
+            )
+        )
+    return findings, info
+
+
+# -- driver -------------------------------------------------------------------
+
+
+def _lint_in_process(
+    include_mesh: bool = True,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    import jax
+
+    findings: List[Finding] = []
+    summary: Dict[str, Any] = {"entries": {}, "devices": jax.device_count()}
+
+    reg, tag_findings = rng_audit.audit_tags(
+        os.path.join(_repo_root(), "corrosion_tpu")
+    )
+    findings.extend(
+        Finding(
+            path=_rel(f.path), line=f.line, rule=f.rule,
+            severity=f.severity, message=f.message,
+        )
+        for f in tag_findings
+    )
+    summary["rng_tags"] = {
+        "definitions": len(reg.defs),
+        "draw_sites": len(reg.draws),
+    }
+
+    include_mesh = include_mesh and jax.device_count() >= REQUIRED_DEVICES
+    for entry in _entries(include_mesh=include_mesh):
+        info: Dict[str, Any] = {}
+        t0 = time.perf_counter()
+        fn, args = entry.build(jax)
+        findings.extend(_check_nondet(jax, entry, fn, args))
+        info["trace_s"] = round(time.perf_counter() - t0, 3)
+
+        if entry.mesh:
+            t1 = time.perf_counter()
+            lowered = fn.lower(*args)
+            compiled = lowered.compile()
+            info["compile_s"] = round(time.perf_counter() - t1, 3)
+            hlo = comm_model.parse_hlo(compiled.as_text())
+            info["collectives"] = hlo.bytes_by_kind()
+            info["loop_collectives"] = hlo.bytes_by_kind(loop_only=True)
+            findings.extend(_check_collectives(entry, hlo))
+            from ..sim import cluster
+
+            mesh = _lint_mesh(jax)
+            declared = cluster.state_shardings(
+                entry.p, mesh, node_axis=MESH_AXES[0], change_axis=MESH_AXES[1]
+            )
+            findings.extend(
+                _check_carry_sharding(jax, entry, compiled, declared, hlo)
+            )
+            budget_findings, budget_info = _check_frame_budget(entry, hlo)
+            findings.extend(budget_findings)
+            info.update(budget_info)
+        summary["entries"][entry.name] = info
+    return sort_findings(findings), summary
+
+
+def _lint_subprocess() -> Tuple[List[Finding], Dict[str, Any]]:
+    env = _provision_env(dict(os.environ))
+    proc = subprocess.run(
+        [sys.executable, "-m", "corrosion_tpu.analysis.semantic", "--json"],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=_repo_root(),
+        timeout=600,
+    )
+    if proc.returncode != 0:
+        return (
+            [
+                Finding(
+                    path="corrosion_tpu/analysis/semantic.py",
+                    line=1,
+                    rule="GL501",
+                    severity=ERROR,
+                    message=(
+                        "semantic lint subprocess failed: "
+                        + (proc.stderr or proc.stdout or "")[-400:]
+                    ),
+                )
+            ],
+            {},
+        )
+    doc = json.loads(proc.stdout)
+    findings = [
+        Finding(
+            path=f["path"], line=f["line"], rule=f["rule"],
+            severity=f["severity"], message=f["message"],
+        )
+        for f in doc.get("findings", ())
+    ]
+    return findings, doc.get("summary", {})
+
+
+def lint_semantic(
+    include_mesh: bool = True,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Run the GL5xx/GL6xx tier; returns (findings, summary).
+
+    Findings are raw — the caller (analysis.lint_repo / the CLI) applies
+    the shared suppression pass so ``# graftlint: disable=GL5xx`` works
+    like every other tier."""
+    if _can_run_in_process():
+        return _lint_in_process(include_mesh=include_mesh)
+    return _lint_subprocess()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="corrosion_tpu.analysis.semantic")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--no-mesh", action="store_true")
+    ns = ap.parse_args(argv)
+    findings, summary = lint_semantic(include_mesh=not ns.no_mesh)
+    if ns.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "summary": summary,
+                }
+            )
+        )
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}: {f.rule} [{f.severity}] {f.message}")
+        print(json.dumps(summary, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

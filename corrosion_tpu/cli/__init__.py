@@ -34,6 +34,7 @@ import time
 from typing import Any, List, Optional
 
 from ..types.config import Config
+from ..utils.aio import cancel_and_wait
 
 
 def _die(msg: str) -> "NoReturn":  # noqa: F821
@@ -314,18 +315,15 @@ async def cmd_template(args) -> int:
             done, _ = await asyncio.wait(
                 [*tasks, stop_task], return_when=asyncio.FIRST_COMPLETED
             )
-            stop_task.cancel()
+            await cancel_and_wait(stop_task)
             for t in done:
                 if t is not stop_task and t.exception() is not None:
                     _die(str(t.exception()))
         except (TemplateError, OSError) as e:
             _die(str(e))
         finally:
-            for t in tasks:
-                t.cancel()
-            for t in tasks:
-                with contextlib.suppress(asyncio.CancelledError, Exception):
-                    await t
+            with contextlib.suppress(Exception):
+                await cancel_and_wait(*tasks)
     return 0
 
 
@@ -351,12 +349,8 @@ async def cmd_consul(args) -> int:
             await asyncio.wait(
                 [task, stop_task], return_when=asyncio.FIRST_COMPLETED
             )
-            stop_task.cancel()
-            task.cancel()
             try:
-                await task
-            except asyncio.CancelledError:
-                pass
+                await cancel_and_wait(stop_task, task)
             except ConsulSyncError as e:
                 _die(str(e))
     finally:
@@ -403,14 +397,21 @@ async def cmd_lint(args) -> int:
         exit_code,
         lint_paths,
         lint_repo,
+        lint_semantic,
         render_json,
         render_text,
+        sort_findings,
     )
 
     if args.paths:
         findings = lint_paths(args.paths)
+        if args.semantic:
+            findings = sort_findings(findings + lint_semantic()[0])
     else:
-        findings = lint_repo(with_contracts=not args.no_contracts)
+        findings = lint_repo(
+            with_contracts=not args.no_contracts,
+            with_semantic=args.semantic,
+        )
     print(render_json(findings) if args.json else render_text(findings))
     return exit_code(findings, fail_on=args.fail_on)
 
@@ -719,6 +720,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-contracts",
         action="store_true",
         help="skip the jax.eval_shape contract pass (pure-AST mode, no jax)",
+    )
+    sp.add_argument(
+        "--semantic",
+        action="store_true",
+        help="add the GL5xx/GL6xx jaxpr/partitioned-HLO tier: lowers and "
+        "compiles every registered entry point (doc/lint.md)",
     )
     sp.set_defaults(fn=cmd_lint)
 

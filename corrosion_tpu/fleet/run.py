@@ -73,6 +73,40 @@ class FleetResult:
         return int(self.rounds.shape[0])
 
 
+def build_lane(p_static: SimParams, R: int):
+    """One sweep lane — the function :func:`run_fleet` vmaps.
+
+    Module-level so the semantic lint tier (analysis/semantic.py) can
+    lower the exact fleet executable abstractly; ``run_fleet`` builds
+    its jit through here."""
+    zeros = {f: jnp.int32(0) for f in TELEMETRY_FIELDS}
+
+    def lane(state, kv, chaos_lane=None):
+        kn = cluster.Knobs(*kv)
+        step = cluster.make_step(
+            p_static, telemetry=True, knobs=kn, chaos_arrays=chaos_lane
+        )
+        full = cluster.full_plane_for(p_static, kn.seed)
+
+        def body(s, _):
+            done = (s[0] == full[None, :]).all()
+            return lax.cond(done, lambda x: (x, zeros), step, s)
+
+        return lax.scan(body, state, None, length=R)
+
+    return lane
+
+
+def build_fleet_fn(p_static: SimParams, R: int, with_chaos: bool):
+    """The ``jax.jit(jax.vmap(lane))`` fleet entry, as a buildable."""
+    lane = build_lane(p_static, R)
+    if with_chaos:
+        return jax.jit(
+            jax.vmap(lambda s, kv, ch: lane(s, kv, ch)), donate_argnums=0
+        )
+    return jax.jit(jax.vmap(lambda s, kv: lane(s, kv)), donate_argnums=0)
+
+
 def run_fleet(
     p_static: SimParams,
     sweep: SweepParams,
@@ -104,21 +138,7 @@ def run_fleet(
     cache = aotmod.default_cache() if aot is None else aot
     B = sweep.n_scenarios
     R = p_static.max_rounds if n_rounds is None else n_rounds
-    zeros = {f: jnp.int32(0) for f in TELEMETRY_FIELDS}
     has_chaos = sweep.chaos_planes is not None
-
-    def lane(state, kv, chaos_lane=None):
-        kn = cluster.Knobs(*kv)
-        step = cluster.make_step(
-            p_static, telemetry=True, knobs=kn, chaos_arrays=chaos_lane
-        )
-        full = cluster.full_plane_for(p_static, kn.seed)
-
-        def body(s, _):
-            done = (s[0] == full[None, :]).all()
-            return lax.cond(done, lambda x: (x, zeros), step, s)
-
-        return lax.scan(body, state, None, length=R)
 
     kvs = (
         jnp.asarray(sweep.seed),
@@ -135,10 +155,7 @@ def run_fleet(
         planes = {k: jnp.asarray(v) for k, v in sweep.chaos_planes.items()}
 
         def build():
-            return jax.jit(
-                jax.vmap(lambda s, kv, ch: lane(s, kv, ch)),
-                donate_argnums=0,
-            )
+            return build_fleet_fn(p_static, R, with_chaos=True)
 
         compiled, info = cache.get_or_compile(
             "fleet.run_fleet", statics, build, (state0, kvs, planes)
@@ -148,9 +165,7 @@ def run_fleet(
     else:
 
         def build():
-            return jax.jit(
-                jax.vmap(lambda s, kv: lane(s, kv)), donate_argnums=0
-            )
+            return build_fleet_fn(p_static, R, with_chaos=False)
 
         compiled, info = cache.get_or_compile(
             "fleet.run_fleet", statics, build, (state0, kvs)

@@ -101,6 +101,30 @@ class FlightRecord:
         return [c / total for c in self.series["complete_pairs"]]
 
 
+def build_scan_fn(p: SimParams, length: int, with_chaos: bool = False):
+    """The flight recorder's jitted scan, as a standalone buildable.
+
+    Factored out of :func:`record_run` so the semantic lint tier
+    (analysis/semantic.py) can lower the *exact* executable the recorder
+    runs — same done-gated body, same donation — without touching the
+    AOT cache or allocating a real state."""
+    full = cluster._full_plane(p)
+    zeros = {f: jnp.int32(0) for f in TELEMETRY_FIELDS}
+
+    def scan_fn(state, ch=None):
+        step = cluster.make_step(p, telemetry=True, chaos_arrays=ch)
+
+        def body(s, _):
+            done = (s[0] == full[None, :]).all()
+            return lax.cond(done, lambda x: (x, zeros), step, s)
+
+        return lax.scan(body, state, None, length=length)
+
+    if not with_chaos:
+        return jax.jit(lambda s: scan_fn(s), donate_argnums=0)
+    return jax.jit(lambda s, ch: scan_fn(s, ch), donate_argnums=0)
+
+
 def record_run(
     p: SimParams,
     chaos=None,
@@ -155,39 +179,30 @@ def record_run(
         f"resume at round {start_round} past the horizon {n_rounds}"
     )
     planes = None if chaos is None else cluster.chaos_operands(p, chaos)
-    full = cluster._full_plane(p)
-    zeros = {f: jnp.int32(0) for f in TELEMETRY_FIELDS}
 
     def build():
-        def scan_fn(state, ch=None):
-            step = cluster.make_step(
-                p, telemetry=True, chaos_arrays=ch
-            )
+        return build_scan_fn(p, length, with_chaos=planes is not None)
 
-            def body(s, _):
-                done = (s[0] == full[None, :]).all()
-                return lax.cond(done, lambda x: (x, zeros), step, s)
-
-            return lax.scan(body, state, None, length=length)
-
-        if planes is None:
-            return jax.jit(lambda s: scan_fn(s), donate_argnums=0)
-        return jax.jit(lambda s, ch: scan_fn(s, ch), donate_argnums=0)
-
+    # resumed segments stay off cross-process disk artifacts — same
+    # deserialized-executable nondeterminism as cluster.run (see the
+    # "resumed" note there); a spliced record must be byte-exact
+    resumed = initial_state is not None
     statics = (
         aotmod.params_key(p),
         ("scan_length", length),
         ("chaos_horizon", None if chaos is None else chaos.horizon),
+        ("resumed", resumed),
     )
     args = (state0,) if planes is None else (state0, planes)
     t0 = time.perf_counter()
     compiled, info = cache.get_or_compile(
-        "flight.record_run", statics, build, args
+        "flight.record_run", statics, build, args, persist=not resumed
     )
     t1 = time.perf_counter()
     out, tel = jax.block_until_ready(compiled(*args))
     rounds_scanned = int(out[-1])  # scalar fetch: see the axon note in run()
     t2 = time.perf_counter()
+    full = cluster._full_plane(p)
     converged = bool((out[0] == full[None, :]).all())
     # the done-gate freezes the round counter at convergence, so the
     # carried counter IS the while_loop's exit round (or n_rounds)

@@ -27,6 +27,7 @@ from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 from ..agent.agent import Agent
 from ..agent.bookkeeping import Current, Partial
 from ..types.actor import ActorId
+from ..utils.aio import cancel_and_wait
 from ..types.broadcast import ChangeSource, ChangesetEmpty, ChangesetFull, ChangeV1
 from ..types.change import Change, ChunkedChanges
 from ..types.clock import ClockDriftError
@@ -163,8 +164,7 @@ class SyncServer:
                 if in_flight:
                     await asyncio.wait(set(in_flight))
             finally:
-                for t in in_flight:
-                    t.cancel()
+                await cancel_and_wait(*in_flight)
             await fs.send(wire.pack(("done",)))
 
     async def _serve_need(
@@ -579,9 +579,8 @@ async def drive_sessions(
             if writer.done() and not writer.cancelled():
                 writer.result()
         finally:
-            writer.cancel()
-            with contextlib.suppress(asyncio.CancelledError, Exception):
-                await writer
+            with contextlib.suppress(Exception):
+                await cancel_and_wait(writer)
             fs.close()
         return count
 

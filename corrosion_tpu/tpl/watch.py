@@ -25,6 +25,7 @@ import tempfile
 from typing import List, Optional
 
 from . import Engine, TemplateError, compile_template
+from ..utils.aio import cancel_and_wait
 from ..utils.retry import RetryPolicy
 
 logger = logging.getLogger(__name__)
@@ -200,9 +201,4 @@ class TemplateWatcher:
                 except (TemplateError, OSError) as e:
                     logger.error("template render failed: %s", e)
         finally:
-            mtime_task.cancel()
-            for t in self._sub_tasks:
-                t.cancel()
-            for t in [mtime_task, *self._sub_tasks]:
-                with contextlib.suppress(asyncio.CancelledError):
-                    await t
+            await cancel_and_wait(mtime_task, *self._sub_tasks)

@@ -36,6 +36,7 @@ import itertools
 import os
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
+from ...utils.aio import cancel_and_wait
 from ...utils.nativebuild import build_if_stale
 
 Addr = Tuple[str, int]
@@ -339,8 +340,12 @@ class NativeTransport:
         self._flush_waiters.clear()
         handle, self._handle = self._handle, None
         self._lib.corro_tp_stop(handle)
-        for t in self._tasks:
-            t.cancel()
+        # teardown path: a handler that died with its native handle is
+        # not worth raising over, but it must be *finished* before the
+        # handle's fds are reused
+        with contextlib.suppress(Exception):
+            await cancel_and_wait(*self._tasks)
+        self._tasks.clear()
 
     # -- outgoing ---------------------------------------------------------
 

@@ -25,7 +25,7 @@ import logging
 import socket
 from typing import List, Optional
 
-from . import tracing
+from . import aio, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -127,9 +127,7 @@ class OtlpExporter:
     async def stop(self) -> None:
         tracing.remove_exporter(self)
         if self._task is not None:
-            self._task.cancel()
-            with contextlib.suppress(asyncio.CancelledError):
-                await self._task
+            await aio.cancel_and_wait(self._task)
             self._task = None
         await self.flush_all()
 

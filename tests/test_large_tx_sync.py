@@ -20,6 +20,21 @@ from tests.test_cluster import boot_node, wait_for
 BIG_TX_ROWS = 10_000  # ref: the one 10k-row changeset (tests.rs:608)
 
 
+async def _post_ok(http: ClientSession, url: str, stmts) -> None:
+    # ALWAYS read the body, even on success.  The 10k-statement response
+    # is ~330 KiB of per-statement results; when it lands in one recv it
+    # crosses aiohttp's 128 KiB read high-watermark (pausing the
+    # transport) AND reaches EOF in the same data_received call, so the
+    # keep-alive pool gets the connection back with reading still
+    # paused.  Only draining the payload below the low-watermark calls
+    # resume_reading — skip the read and the next request reusing that
+    # connection waits forever for a response the transport never
+    # delivers (the flaky "server-side stall" was exactly this).
+    async with http.post(url, json=stmts) as r:
+        body = await r.text()
+        assert r.status == 200, body
+
+
 async def _large_tx_sync(total_rows: int, small_tx_rows: int, timeout: float):
     n1 = await boot_node()
     try:
@@ -31,16 +46,14 @@ async def _large_tx_sync(total_rows: int, small_tx_rows: int, timeout: float):
                 ["INSERT INTO tests (id,text) VALUES (?,?)", [i, f"big{i:06d}" * 4]]
                 for i in range(BIG_TX_ROWS)
             ]
-            r = await http.post(f"{n1.api_base}/v1/transactions", json=stmts)
-            assert r.status == 200, await r.text()
+            await _post_ok(http, f"{n1.api_base}/v1/transactions", stmts)
             # then many smaller versions (ref: 100 txns of 550 rows)
             for i in range(BIG_TX_ROWS, total_rows, small_tx_rows):
                 stmts = [
                     ["INSERT INTO tests (id,text) VALUES (?,?)", [j, f"v{j}"]]
                     for j in range(i, min(i + small_tx_rows, total_rows))
                 ]
-                r = await http.post(f"{n1.api_base}/v1/transactions", json=stmts)
-                assert r.status == 200
+                await _post_ok(http, f"{n1.api_base}/v1/transactions", stmts)
 
         # the big version really was chunked
         big = n1.agent.bookie.get(n1.agent.actor_id).versions.current[1]

@@ -342,6 +342,52 @@ class S:
     assert "GL204" not in async_rules(good)
 
 
+# -- GL205: cancel then bare await --------------------------------------------
+
+def test_gl205_cancel_then_bare_await():
+    bad = """
+import asyncio
+class S:
+    async def stop(self):
+        self._task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await self._task
+"""
+    assert "GL205" in async_rules(bad)
+
+
+def test_gl205_cancel_then_wait_for():
+    bad = """
+import asyncio
+class S:
+    async def stop(self, task):
+        task.cancel()
+        await asyncio.wait_for(task, 5.0)
+"""
+    assert "GL205" in async_rules(bad)
+
+
+def test_gl205_cancel_and_wait_not_flagged():
+    good = """
+import asyncio
+from corrosion_tpu.utils.aio import cancel_and_wait
+class S:
+    async def stop(self):
+        await cancel_and_wait(self._task)
+"""
+    assert "GL205" not in async_rules(good)
+
+
+def test_gl205_await_of_uncancelled_task_not_flagged():
+    good = """
+import asyncio
+class S:
+    async def join(self):
+        await self._task
+"""
+    assert "GL205" not in async_rules(good)
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_suppression_with_reason_suppresses():

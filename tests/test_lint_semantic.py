@@ -1,0 +1,333 @@
+"""GL5xx/GL6xx semantic-lint tier: planted-defect fixtures + clean gate.
+
+Each planted fixture is a *twin* of a real defect class, compiled under
+the same 4x2 'nodes'x'changes' mesh the production checker uses, with
+its provenance anchored in THIS file — so a finding with the wrong
+provenance fails the assertion, not just a missing finding:
+
+- mis-sharded twin: a global reduction over a 'nodes'-sharded array from
+  a file outside the collective allowlist -> GL501
+- carry-resharding twin: a scan whose body re-constrains the carry to a
+  different mesh axis every iteration -> GL502
+- duplicated ``TAG_*`` values / cross-subsystem draws -> GL601
+- PRNG primitives inside a scan body -> GL602
+
+The clean gate at the bottom runs the full registered entry-point set at
+``--fail-on warning`` strictness and doubles as the <60 s runtime bound
+for the tier (ROADMAP tier-1).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from corrosion_tpu.analysis import comm_model, lint_semantic, semantic
+from corrosion_tpu.analysis.rng_audit import check_registry, harvest
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < semantic.REQUIRED_DEVICES,
+    reason=f"semantic tier needs {semantic.REQUIRED_DEVICES} devices",
+)
+
+THIS_FILE = "tests/test_lint_semantic.py"
+
+
+def _entry(name="planted"):
+    return semantic.EntrySpec(name=name, path=THIS_FILE, build=None)
+
+
+def _compile_on_mesh(fn, aval, in_sharding):
+    jitted = jax.jit(fn, in_shardings=(in_sharding,), out_shardings=None)
+    return jitted.lower(aval).compile()
+
+
+# -- comm_model parser (pure text, no compilation) ---------------------------
+
+SYNTHETIC_HLO = """\
+HloModule planted
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %a, f32[] %b)
+}
+
+%body (p: (f32[256], s32[])) -> (f32[256], s32[]) {
+  %p = (f32[256], s32[]) parameter(0)
+  %x = f32[256] get-tuple-element((f32[256], s32[]) %p), index=0
+  %ar = f32[256] all-reduce(f32[256] %x), to_apply=%add, metadata={op_name="while/body/reduce" source_file="/root/repo/corrosion_tpu/sim/cluster.py" source_line=42}
+  %i = s32[] get-tuple-element((f32[256], s32[]) %p), index=1
+  ROOT %t = (f32[256], s32[]) tuple(f32[256] %ar, s32[] %i)
+}
+
+%cond (p: (f32[256], s32[])) -> pred[] {
+  %p = (f32[256], s32[]) parameter(0)
+  %i = s32[] get-tuple-element((f32[256], s32[]) %p), index=1
+  %k = s32[] constant(8)
+  ROOT %lt = pred[] compare(s32[] %i, s32[] %k), direction=LT
+}
+
+ENTRY %main (arg: f32[256]) -> f32[256] {
+  %arg = f32[256] parameter(0)
+  %ag = f32[512] all-gather(f32[256] %arg), dimensions={0}, metadata={op_name="gather" source_file="/root/repo/corrosion_tpu/sim/frames.py" source_line=7}
+  %z = s32[] constant(0)
+  %sl = f32[256] slice(f32[512] %ag), slice={[0:256]}
+  %tup = (f32[256], s32[]) tuple(f32[256] %sl, s32[] %z)
+  %w = (f32[256], s32[]) while((f32[256], s32[]) %tup), condition=%cond, body=%body
+  ROOT %out = f32[256] get-tuple-element((f32[256], s32[]) %w), index=0
+}
+"""
+
+
+def test_comm_model_parses_kinds_bytes_and_loop_attribution():
+    model = comm_model.parse_hlo(SYNTHETIC_HLO)
+    kinds = {c.kind for c in model.collectives}
+    assert kinds == {"all-reduce", "all-gather"}
+    # the all-reduce sits in the while body; the all-gather in ENTRY
+    (ar,) = [c for c in model.collectives if c.kind == "all-reduce"]
+    (ag,) = [c for c in model.collectives if c.kind == "all-gather"]
+    assert ar.in_loop_body and not ag.in_loop_body
+    assert ar.bytes == 256 * 4 and ag.bytes == 512 * 4
+    assert ar.source_file.endswith("sim/cluster.py") and ar.source_line == 42
+    assert model.per_round_bytes() == 256 * 4
+
+
+def test_comm_model_handles_tuple_typed_computation_headers():
+    # the while body/cond params above are tuple-typed — nested parens
+    # must not break the computation splitter (they did, once)
+    model = comm_model.parse_hlo(SYNTHETIC_HLO)
+    assert {"body", "cond", "main", "add"} <= set(model.computations)
+    assert "body" in model.loop_bodies and "cond" in model.loop_bodies
+
+
+# -- GL501: mis-sharded twin --------------------------------------------------
+
+
+def test_gl501_planted_missharded_twin_fires_with_provenance():
+    mesh = semantic._lint_mesh(jax)
+    sh = NamedSharding(mesh, P("nodes"))
+
+    def twin(x):
+        # a global reduction over the 'nodes'-sharded axis: the
+        # partitioner MUST insert an all-reduce, anchored to this line
+        return jnp.sum(x * 2.0)
+
+    compiled = _compile_on_mesh(
+        twin, jax.ShapeDtypeStruct((1024,), jnp.float32), sh
+    )
+    model = comm_model.parse_hlo(compiled.as_text())
+    assert model.collectives, "partitioner inserted no collectives"
+
+    findings = semantic._check_collectives(_entry(), model)
+    gl501 = [f for f in findings if f.rule == "GL501"]
+    assert gl501, "mis-sharded twin not caught"
+    # provenance must point at this test file, not at sim/
+    assert any(f.path.endswith("test_lint_semantic.py") for f in gl501)
+
+
+def test_gl501_allowlisted_sim_provenance_passes():
+    model = comm_model.parse_hlo(SYNTHETIC_HLO)
+    # both synthetic collectives carry sim/ provenance in the allowlist
+    assert semantic._check_collectives(_entry(), model) == []
+
+
+# -- GL502: carry-resharding twin ---------------------------------------------
+
+
+def test_gl502_planted_carry_resharding_twin_fires():
+    mesh = semantic._lint_mesh(jax)
+    sh_nodes = NamedSharding(mesh, P("nodes"))
+    sh_changes = NamedSharding(mesh, P("changes"))
+
+    def twin(x):
+        def body(c, _):
+            # re-constrain the carry to the OTHER mesh axis every
+            # iteration: a reshard per round, O(rounds) comm
+            c = jax.lax.with_sharding_constraint(c, sh_changes)
+            return c + 1.0, None
+
+        y, _ = jax.lax.scan(body, x, None, length=8)
+        return y
+
+    compiled = _compile_on_mesh(
+        twin, jax.ShapeDtypeStruct((1024,), jnp.float32), sh_nodes
+    )
+    model = comm_model.parse_hlo(compiled.as_text())
+    findings = semantic._check_carry_sharding(
+        jax, _entry(), compiled, [sh_nodes], model
+    )
+    assert any(f.rule == "GL502" for f in findings), (
+        "carry-resharding twin not caught"
+    )
+
+
+def test_gl502_stable_carry_passes():
+    mesh = semantic._lint_mesh(jax)
+    sh_nodes = NamedSharding(mesh, P("nodes"))
+
+    def stable(x):
+        def body(c, _):
+            return c * 2.0, None
+
+        y, _ = jax.lax.scan(body, x, None, length=8)
+        return y
+
+    compiled = _compile_on_mesh(
+        stable, jax.ShapeDtypeStruct((1024,), jnp.float32), sh_nodes
+    )
+    model = comm_model.parse_hlo(compiled.as_text())
+    findings = semantic._check_carry_sharding(
+        jax, _entry(), compiled, [sh_nodes], model
+    )
+    assert findings == []
+
+
+# -- GL601: counter-RNG tag audit ---------------------------------------------
+
+
+def _write(tmp_path, rel, text):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(text)
+    return p
+
+
+def test_gl601_duplicate_tag_value_collision(tmp_path):
+    f = _write(
+        tmp_path,
+        "sim/rng.py",
+        "TAG_FOO = 5\n"
+        "TAG_BAR = 5\n"
+        "def draw(seed, i):\n"
+        "    return py_hash(seed, TAG_FOO, i)\n",
+    )
+    reg = harvest([f], roots=[tmp_path])
+    findings = check_registry(reg)
+    errs = [f for f in findings if f.rule == "GL601" and f.severity == "error"]
+    assert errs, "duplicate TAG value not caught"
+    assert any("TAG_FOO" in f.message or "TAG_BAR" in f.message for f in errs)
+
+
+def test_gl601_cross_subsystem_reuse_warns(tmp_path):
+    a = _write(
+        tmp_path,
+        "sim/rng.py",
+        "TAG_PRIVATE = 3\n"
+        "def d(seed, i):\n"
+        "    return py_hash(seed, TAG_PRIVATE, i)\n",
+    )
+    b = _write(
+        tmp_path,
+        "chaos/oracle.py",
+        "from sim.rng import TAG_PRIVATE\n"
+        "def d2(seed, i):\n"
+        "    return jx_hash(seed, TAG_PRIVATE, i)\n",
+    )
+    reg = harvest([a, b], roots=[tmp_path])
+    findings = check_registry(reg)
+    warns = [
+        f for f in findings if f.rule == "GL601" and f.severity == "warning"
+    ]
+    assert warns, "cross-subsystem tag draw not caught"
+
+
+def test_gl601_repo_is_clean():
+    from corrosion_tpu.analysis.rng_audit import audit_tags
+    import corrosion_tpu
+
+    import os
+
+    reg, findings = audit_tags(os.path.dirname(corrosion_tpu.__file__))
+    assert reg.defs, "harvest found no TAG definitions"
+    assert findings == [], [f.message for f in findings]
+
+
+# -- GL602: non-determinism in loop bodies ------------------------------------
+
+
+def test_gl602_prng_inside_scan_body_fires():
+    def twin(x):
+        def body(c, _):
+            key = jax.random.PRNGKey(0)
+            return c + jax.random.uniform(key, c.shape), None
+
+        y, _ = jax.lax.scan(body, x, None, length=4)
+        return y
+
+    findings = semantic._check_nondet(
+        jax,
+        _entry(),
+        jax.jit(twin),
+        (jax.ShapeDtypeStruct((64,), jnp.float32),),
+    )
+    assert any(f.rule == "GL602" for f in findings), (
+        "PRNG inside scan body not caught"
+    )
+
+
+def test_gl602_prng_outside_loop_passes():
+    def fine(x):
+        key = jax.random.PRNGKey(0)  # outside any loop: reproducible
+        noise = jax.random.uniform(key, x.shape)
+
+        def body(c, _):
+            return c + noise, None
+
+        y, _ = jax.lax.scan(body, x, None, length=4)
+        return y
+
+    findings = semantic._check_nondet(
+        jax,
+        _entry(),
+        jax.jit(fine),
+        (jax.ShapeDtypeStruct((64,), jnp.float32),),
+    )
+    assert findings == []
+
+
+# -- the gate: every registered entry point, warning-strict, bounded ----------
+
+
+def test_semantic_gate_all_entries_clean_and_under_60s():
+    t0 = time.monotonic()
+    findings, summary = lint_semantic()
+    took = time.monotonic() - t0
+    assert findings == [], [
+        f"{f.path}:{f.line} {f.rule} {f.message}" for f in findings
+    ]
+    assert took < 60.0, f"semantic tier took {took:.1f}s (budget 60s)"
+
+    entries = summary["entries"]
+    # every registered entry point ran
+    assert {e.name for e in semantic._entries()} == set(entries)
+    # the mesh entries carry the GL503 comm-bytes model vs frame budget
+    dense = entries["sim.run_loop@mesh4x2[dense-n1024]"]
+    assert dense["per_round_collective_bytes"] > 0
+    assert dense["frame_bytes_per_round"] > 0
+    assert (
+        dense["per_round_collective_bytes"]
+        <= semantic.GL503_MARGIN * dense["frame_bytes_per_round"]
+    )
+
+
+def test_ast_gate_sim_fleet_chaos_warning_clean():
+    """AST tiers at --fail-on warning over the device-program dirs."""
+    import os
+
+    import corrosion_tpu
+    from corrosion_tpu.analysis import exit_code, lint_paths
+
+    pkg = os.path.dirname(corrosion_tpu.__file__)
+    findings = lint_paths(
+        [
+            os.path.join(pkg, "sim"),
+            os.path.join(pkg, "fleet"),
+            os.path.join(pkg, "chaos", "lower.py"),
+        ]
+    )
+    assert exit_code(findings, fail_on="warning") == 0, [
+        f"{f.path}:{f.line} {f.rule} {f.message}" for f in findings
+    ]

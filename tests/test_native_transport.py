@@ -12,6 +12,7 @@ import asyncio
 import pytest
 
 from corrosion_tpu.transport.native import NativeTransport, load
+from corrosion_tpu.utils.aio import cancel_and_wait
 from corrosion_tpu.transport.net import Transport
 
 
@@ -356,7 +357,7 @@ def test_stalled_peer_reaped_and_flush_unblocked():
             assert a.stats()["conns_dropped"] >= 1
             assert took < 8.0, took
         finally:
-            task.cancel()
+            await cancel_and_wait(task)
             for conn in accepted:
                 conn.close()
             srv.close()

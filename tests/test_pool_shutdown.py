@@ -13,6 +13,7 @@ import asyncio
 import time
 
 from corrosion_tpu.agent.pool import SplitPool
+from corrosion_tpu.utils.aio import cancel_and_wait
 
 
 def run(coro):
@@ -33,11 +34,7 @@ def test_cancelled_read_then_aclose_does_not_crash():
         pool.open()
         task = asyncio.create_task(pool.read_call(_slow_read))
         await asyncio.sleep(0.05)  # thread is inside _slow_read now
-        task.cancel()
-        try:
-            await task
-        except asyncio.CancelledError:
-            pass
+        await cancel_and_wait(task)
         # must WAIT for the thread to finish before closing its conn
         t0 = time.monotonic()
         await pool.aclose()
@@ -64,11 +61,7 @@ def test_cancelled_write_keeps_permit_until_thread_done():
 
         t1 = asyncio.create_task(pool.write_call(w1))
         await asyncio.sleep(0.05)
-        t1.cancel()
-        try:
-            await t1
-        except asyncio.CancelledError:
-            pass
+        await cancel_and_wait(t1)
         # a second writer must not run while w1's thread still writes
         await pool.write_call(w2)
         assert order == ["w1-start", "w1-end", "w2"], order

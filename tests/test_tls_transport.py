@@ -6,6 +6,10 @@ import asyncio
 
 import pytest
 
+# cert generation needs the optional `cryptography` package; without it
+# the whole module is a skip, not a collection error
+pytest.importorskip("cryptography")
+
 from corrosion_tpu.agent.node import Node
 from corrosion_tpu.client import CorrosionApiClient
 from corrosion_tpu.types.config import Config, GossipTlsConfig

@@ -14,6 +14,7 @@ from corrosion_tpu.pubsub import SubsManager
 from corrosion_tpu.pubsub import matcher as matcher_mod
 from corrosion_tpu.tpl import Engine, TemplateError, compile_template
 from corrosion_tpu.tpl.watch import TemplateWatcher, parse_template_spec
+from corrosion_tpu.utils.aio import cancel_and_wait
 
 SCHEMA = (
     "CREATE TABLE todos (id INTEGER NOT NULL PRIMARY KEY, "
@@ -210,9 +211,7 @@ def test_watch_renders_and_rerenders_on_change(tmp_path):
                     await asyncio.sleep(0.05)
                 assert dst.read_text() == "- first\n- second\n"
             finally:
-                task.cancel()
-                with pytest.raises(asyncio.CancelledError):
-                    await task
+                await cancel_and_wait(task)
         await subs.stop()
         await api.stop()
         agent.close()

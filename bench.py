@@ -440,6 +440,15 @@ def main() -> None:
         "<= 0 replays flat out)",
     )
     ap.add_argument(
+        "--matcher-subs",
+        type=int,
+        nargs="*",
+        default=[1_000, 10_000, 100_000],
+        help="vectorized-matcher throughput legs appended to --serve "
+        "output: one JSON line per standing-subscription count "
+        "(corrosion_tpu/pubsub/vmatch; pass no values to skip)",
+    )
+    ap.add_argument(
         "--mesh-dryrun",
         action="store_true",
         help="run the 8-device 2-D-mesh dryrun leg instead: execute the "
@@ -457,12 +466,26 @@ def main() -> None:
 
     if args.serve:
         # pure-CPU asyncio leg: no device, no compile cache — keep JAX out
-        from corrosion_tpu.harness.loadgen import run_serve_bench
+        # until the replay has finished (the matcher legs below import it)
+        from corrosion_tpu.harness.loadgen import (
+            run_matcher_bench,
+            run_serve_bench,
+        )
 
         t0 = time.perf_counter()
         out = run_serve_bench(args.seed, args.serve_qps)
         print(json.dumps(out), flush=True)
         log(f"serve leg wall: {time.perf_counter()-t0:.2f}s")
+        # vectorized-matcher throughput at 1k/10k/100k standing subs
+        # (pubsub/vmatch; these legs DO use the device)
+        for n_subs in args.matcher_subs:
+            t0 = time.perf_counter()
+            out = run_matcher_bench(n_subs, seed=args.seed)
+            print(json.dumps(out), flush=True)
+            log(
+                f"matcher leg ({n_subs} subs) wall: "
+                f"{time.perf_counter()-t0:.2f}s"
+            )
         return
 
     t_all = time.perf_counter()

@@ -103,7 +103,12 @@ class Node:
 
             self._subs_tmpdir = tempfile.TemporaryDirectory(prefix="corro-subs-")
             subs_path = self._subs_tmpdir.name
-        self.subs = SubsManager(subs_path, self.agent.pool)
+        # serving-plane tuning ([pubsub] config section): candidate
+        # window, slow-consumer policy, optional vectorized matcher
+        self.config.pubsub.validate()
+        self.subs = SubsManager(
+            subs_path, self.agent.pool, config=self.config.pubsub
+        )
         await self.subs.restore()  # ref: run_root.rs:229-282
         self.subs.start()
 

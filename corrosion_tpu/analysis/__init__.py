@@ -26,10 +26,13 @@ from .report import exit_code, render_json, render_text, severity_counts
 from .rules import RULES, Finding, sort_findings
 from .suppress import apply_suppressions, scan_suppressions
 
-# Pass scopes, relative to the package root (corrosion_tpu/).
-TRACE_SAFETY_DIRS = ("sim", "crdt")
+# Pass scopes, relative to the package root (corrosion_tpu/).  An entry
+# may be a nested "dir/subdir" to scope a pass to one device-program
+# package inside an otherwise-host-side dir (pubsub/vmatch is jitted
+# JAX; the rest of pubsub/ is asyncio + sqlite).
+TRACE_SAFETY_DIRS = ("sim", "crdt", "pubsub/vmatch")
 ASYNC_DIRS = ("agent", "swim", "sync", "broadcast", "transport")
-DONATION_DIRS = ("sim", "crdt", "fleet")
+DONATION_DIRS = ("sim", "crdt", "fleet", "pubsub/vmatch")
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -54,11 +57,19 @@ def lint_file(path: str, repo_root: Optional[str] = None) -> List[Finding]:
     findings: List[Finding] = []
     parts = rel.replace(os.sep, "/").split("/")
     scope = parts[1] if len(parts) > 1 and parts[0] == "corrosion_tpu" else None
-    if scope in TRACE_SAFETY_DIRS or scope is None:
+    # nested scope: "pubsub/vmatch" matches only the sub-package
+    nested = "/".join(parts[1:3]) if len(parts) > 2 else None
+
+    def _in(dirs: Sequence[str]) -> bool:
+        return scope is None or scope in dirs or (
+            nested is not None and nested in dirs
+        )
+
+    if _in(TRACE_SAFETY_DIRS):
         findings.extend(trace_safety.check_source(rel, source))
-    if scope in ASYNC_DIRS or scope is None:
+    if _in(ASYNC_DIRS):
         findings.extend(async_discipline.check_source(rel, source))
-    if scope in DONATION_DIRS or scope is None:
+    if _in(DONATION_DIRS):
         findings.extend(donation.check_source(rel, source))
     sups, meta = scan_suppressions(rel, source)
     findings = apply_suppressions(findings, sups)

@@ -64,7 +64,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
 
 from ..pubsub import LAGGED_ERROR
 from ..utils.aio import cancel_and_wait
-from ..sim.rng import TAG_SERVE, py_below
+from ..sim.rng import TAG_SERVE, TAG_SERVE_SUBS, py_below
 from ..utils.metrics import counter
 
 __all__ = [
@@ -73,8 +73,10 @@ __all__ = [
     "TrafficOp",
     "acceptance_schedule",
     "build_traffic",
+    "run_matcher_bench",
     "run_serve_bench",
     "schedule_digest",
+    "synthetic_subscriptions",
     "replay",
 ]
 
@@ -117,6 +119,8 @@ class LoadgenParams:
     queue_size: Optional[int] = None  # per-subscriber bound (None: default)
     stalled_subscribers: int = 0  # matcher-level never-drained attaches
     faults: Optional[object] = None  # chaos.runtime.ServingFaultPlan
+    n_synthetic_subs: int = 0  # extra standing SELECTs (synthetic_subscriptions)
+    vectorized_matcher: bool = False  # route changes through pubsub/vmatch
 
 
 @dataclass
@@ -208,6 +212,66 @@ def schedule_digest(ops: Sequence[TrafficOp]) -> str:
         h.update(op.line().encode())
         h.update(b"\n")
     return h.hexdigest()
+
+
+# -- synthetic subscriptions ------------------------------------------------
+
+# template families for generated standing SELECTs over the loadtest
+# schema; the weights deliberately mix device-lowerable pruning
+# predicates (pk ranges / IN / OR), lowerable-but-non-pruning ones
+# (origin isn't the pk, so its atoms evaluate UNKNOWN), SQLite-fallback
+# predicates (LIKE), and WHERE-less catch-alls — the mix the vectorized
+# matcher must route correctly, not just the easy cases
+_SYNTH_FAMILIES = 10
+
+
+def synthetic_subscriptions(n: int, seed: int = 0) -> List[str]:
+    """``n`` deterministic standing SELECTs over the loadtest schema
+    (counter-RNG: pure function of ``(n, seed)``), used to scale the
+    subscription population far past the 8 live HTTP streams the replay
+    fans out — the vectorized-matcher bench compiles these at 1k/10k/
+    100k subscribers."""
+    out: List[str] = []
+    for i in range(n):
+        fam = py_below(_SYNTH_FAMILIES, seed, TAG_SERVE_SUBS, i, 0)
+        a = py_below(100_000, seed, TAG_SERVE_SUBS, i, 1)
+        width = 1 + py_below(500, seed, TAG_SERVE_SUBS, i, 2)
+        o = py_below(64, seed, TAG_SERVE_SUBS, i, 3)
+        if fam <= 2:  # pk range: lowered, pruning
+            sql = (
+                "SELECT id, origin, text FROM loadtest "
+                f"WHERE id >= {a} AND id < {a + width}"
+            )
+        elif fam == 3:  # pk IN list: lowered, pruning
+            ks = sorted(
+                {a, a + width, a + 2 * width + o}
+            )
+            sql = (
+                "SELECT id FROM loadtest WHERE id IN ("
+                + ", ".join(str(k) for k in ks)
+                + ")"
+            )
+        elif fam == 4:  # OR of pk equalities: lowered, pruning
+            sql = (
+                "SELECT id, text FROM loadtest "
+                f"WHERE id = {a} OR id = {a + width}"
+            )
+        elif fam == 5:  # BETWEEN sugar: lowered, pruning
+            sql = (
+                "SELECT id FROM loadtest "
+                f"WHERE id BETWEEN {a} AND {a + width}"
+            )
+        elif fam <= 7:  # non-pk column: lowered but never prunes
+            sql = f"SELECT id, origin FROM loadtest WHERE origin = {o}"
+        elif fam == 8:  # LIKE: unsupported → per-sub SQLite fallback
+            sql = (
+                "SELECT id, text FROM loadtest "
+                f"WHERE text LIKE 'r{o % 10}%'"
+            )
+        else:  # catch-all, no WHERE
+            sql = "SELECT id, origin, text FROM loadtest"
+        out.append(sql)
+    return out
 
 
 # -- subscribers ------------------------------------------------------------
@@ -409,7 +473,10 @@ async def replay(
 
     agent = Agent(AgentConfig(db_path=":memory:", read_conns=4)).open_sync()
     await agent.pool.write_call(lambda c: apply_schema(c, LOADTEST_SCHEMA))
-    subs = SubsManager(subs_path, agent.pool, queue_size=params.queue_size)
+    subs = SubsManager(
+        subs_path, agent.pool, queue_size=params.queue_size,
+        vmatch=params.vectorized_matcher,
+    )
     subs.start()
     api = Api(agent, subs=subs)
     port = await api.start()
@@ -456,6 +523,16 @@ async def replay(
         # the ledger row set is cleanly snapshot ∪ changes per stream
         matcher, _ = await subs.get_or_insert(LOADTEST_SQL)
         await asyncio.wait_for(matcher.ready.wait(), 10)
+
+        # scale the standing-subscription population past the live HTTP
+        # streams: generated predicates register real matchers (distinct
+        # SQL dedups through get_or_insert, so the registered count can
+        # be below the requested n)
+        for sql in synthetic_subscriptions(
+            params.n_synthetic_subs, seed=params.seed
+        ):
+            m, _created = await subs.get_or_insert(sql)
+            await asyncio.wait_for(m.ready.wait(), 10)
 
         # never-drained matcher-level attaches: the slow-consumer probe
         for _ in range(params.stalled_subscribers):
@@ -648,6 +725,108 @@ def run_serve_bench(
     return out
 
 
+# -- matcher-throughput bench (bench.py --serve) ----------------------------
+
+
+def _interpreted_walk(subs_meta, changes) -> int:
+    """The per-subscription Python routing walk the vectorized matcher
+    replaces: for EVERY standing matcher, scan the change batch, keep
+    trigger-table hits, and accumulate candidate pks per table — the
+    exact work ``SubsManager.match_changes`` + ``Matcher.filter_changes``
+    do before anything touches sub.sqlite.  Returns the number of
+    matchers that would have been fed."""
+    fed = 0
+    for tables in subs_meta:
+        cands: Dict[str, Set[Tuple]] = {}
+        for tbl, pkv in changes:
+            if tbl not in tables:
+                continue
+            cands.setdefault(tbl, set()).add(tuple(pkv))
+        if cands:
+            fed += 1
+    return fed
+
+
+def run_matcher_bench(
+    n_subs: int,
+    seed: int = 0,
+    n_changes: int = 256,
+    chunk: int = 128,
+    reps: int = 3,
+    walk_sample: int = 2048,
+) -> Dict[str, object]:
+    """One vectorized-matcher throughput leg → a BENCH JSON line dict.
+
+    Compiles ``n_subs`` generated standing predicates into one program
+    set, evaluates a ``n_changes`` ledger-shaped change batch on device
+    (best of ``reps`` after a warmup rep that also pays compilation),
+    and times the per-subscription Python walk over the same batch as
+    the baseline.  Throughput is (subs × changes) routed per second.
+    Above ``walk_sample`` subscriptions the walk baseline times a
+    sample and scales — the walk is O(S·C) by construction, and timing
+    100k × 256 pairs of pure Python would dominate the bench wall."""
+    from ..pubsub.sql import parse_select
+    from ..pubsub.vmatch.compile import ProgramSet, compile_sub
+    from ..pubsub.vmatch.eval import BatchEvaluator
+
+    sqls = synthetic_subscriptions(n_subs, seed=seed)
+    t0 = time.perf_counter()
+    progs = [
+        compile_sub(f"bench-{i}", parse_select(sql), [["id"]], {"loadtest"})
+        for i, sql in enumerate(sqls)
+    ]
+    ps = ProgramSet(progs)
+    compile_s = time.perf_counter() - t0
+
+    # a ledger-shaped change batch: mostly loadtest pk writes, with a
+    # sprinkle of foreign-table rows the router must never misroute
+    changes = []
+    for c in range(n_changes):
+        if c % 17 == 13:
+            changes.append(("other_table", [c]))
+        else:
+            changes.append(
+                ("loadtest", [py_below(120_000, seed, TAG_SERVE_SUBS, -1, c)])
+            )
+
+    ev = BatchEvaluator(ps, chunk=chunk, use_aot=False)
+    match = ev.match(changes)  # warmup rep: pays trace+compile
+    device_wall = ev.last_eval_s
+    for _ in range(max(0, reps - 1)):
+        ev.match(changes)
+        device_wall = min(device_wall, ev.last_eval_s)
+    device_tp = n_subs * n_changes / max(device_wall, 1e-9)
+    fed_device = int(match.any(axis=1).sum())
+
+    sample = min(n_subs, walk_sample)
+    subs_meta = [frozenset({"loadtest"})] * sample
+    walk_wall = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _interpreted_walk(subs_meta, changes)
+        w = time.perf_counter() - t0
+        walk_wall = w if walk_wall is None else min(walk_wall, w)
+    walk_tp = sample * n_changes / max(walk_wall, 1e-9)
+
+    return {
+        "metric": "matcher_throughput",
+        "n_subs": n_subs,
+        "n_changes": n_changes,
+        "seed": seed,
+        "chunk": chunk,
+        "prog_len": int(ps.prog_op.shape[1]),
+        "compiled_subs": ps.n_compiled,
+        "fallback_subs": ps.n_fallback,
+        "compile_s": round(compile_s, 4),
+        "device_eval_s": round(device_wall, 6),
+        "device_throughput": int(device_tp),
+        "walk_throughput": int(walk_tp),
+        "walk_measured_subs": sample,
+        "speedup": round(device_tp / max(walk_tp, 1e-9), 2),
+        "matched_subs": fed_device,
+    }
+
+
 # -- BENCHMARKS.md serve section (generated, never hand-edited) -------------
 
 BEGIN_MARK = (
@@ -700,9 +879,64 @@ def serve_markdown(lines: List[dict]) -> str:
     return "\n".join(out)
 
 
+MATCH_BEGIN_MARK = (
+    "<!-- matcher:begin (generated by corrosion_tpu.harness.loadgen; "
+    "do not hand-edit) -->"
+)
+MATCH_END_MARK = "<!-- matcher:end -->"
+
+
+def matcher_markdown(lines: List[dict]) -> str:
+    """Render the vectorized-matcher section from bench JSON lines."""
+    out = [
+        MATCH_BEGIN_MARK,
+        "",
+        "## Vectorized subscription matcher (pubsub/vmatch)",
+        "",
+        "Standing WHERE predicates compile into fixed-width opcode",
+        "programs evaluated for ALL subscriptions against a change batch",
+        "in one jitted device pass; IN-subqueries / LIKE / joins fall",
+        "back per-subscription to the SQLite diff path.  `dev/s` and",
+        "`walk/s` are (subscriptions × changes) routed per second for",
+        "the device matcher vs the per-subscription Python walk it",
+        "replaces (sampled and scaled above 2048 subs); the predicate",
+        "mix is the seeded generator in harness/loadgen.py.",
+        "",
+        "| subs | compiled | fallback | changes | dev/s | walk/s |"
+        " speedup | eval wall |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for ln in lines:
+        if ln.get("metric") != "matcher_throughput":
+            continue
+        out.append(
+            "| {s} | {c} | {f} | {n} | {dv:.2e} | {wk:.2e} | {sp}x |"
+            " {w:.4f}s |".format(
+                s=ln.get("n_subs", "?"),
+                c=ln.get("compiled_subs", "?"),
+                f=ln.get("fallback_subs", "?"),
+                n=ln.get("n_changes", "?"),
+                dv=float(ln.get("device_throughput", 0)),
+                wk=float(ln.get("walk_throughput", 0)),
+                sp=ln.get("speedup", "?"),
+                w=float(ln.get("device_eval_s", 0.0)),
+            )
+        )
+    out += ["", MATCH_END_MARK]
+    return "\n".join(out)
+
+
+def _splice(doc: str, section: str, begin: str, end: str) -> str:
+    if begin in doc and end in doc:
+        head, rest = doc.split(begin, 1)
+        _, tail = rest.split(end, 1)
+        return head + section + tail
+    return doc.rstrip("\n") + "\n\n" + section + "\n"
+
+
 def update_benchmarks(bench_json_path: str, md_path: str) -> None:
-    """Replace (or append) the marker-delimited serve section of
-    ``md_path`` — same contract as the convergence section
+    """Replace (or append) the marker-delimited serve + matcher
+    sections of ``md_path`` — same contract as the convergence section
     (sim/flight.py)."""
     lines = []
     with open(bench_json_path) as f:
@@ -713,15 +947,13 @@ def update_benchmarks(bench_json_path: str, md_path: str) -> None:
                     lines.append(json.loads(raw))
                 except json.JSONDecodeError:
                     pass
-    section = serve_markdown(lines)
     with open(md_path) as f:
         doc = f.read()
-    if BEGIN_MARK in doc and END_MARK in doc:
-        head, rest = doc.split(BEGIN_MARK, 1)
-        _, tail = rest.split(END_MARK, 1)
-        doc = head + section + tail
-    else:
-        doc = doc.rstrip("\n") + "\n\n" + section + "\n"
+    doc = _splice(doc, serve_markdown(lines), BEGIN_MARK, END_MARK)
+    if any(ln.get("metric") == "matcher_throughput" for ln in lines):
+        doc = _splice(
+            doc, matcher_markdown(lines), MATCH_BEGIN_MARK, MATCH_END_MARK
+        )
     with open(md_path, "w") as f:
         f.write(doc)
 

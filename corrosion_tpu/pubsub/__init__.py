@@ -52,26 +52,75 @@ class SubsManager:
     """Registry of live subscription matchers (ref: SubsManager)."""
 
     def __init__(
-        self, subs_path: str, pool, queue_size: Optional[int] = None
+        self,
+        subs_path: str,
+        pool,
+        queue_size: Optional[int] = None,
+        config=None,  # types.config.PubsubConfig, threaded by agent/node.py
+        vmatch: Optional[bool] = None,
     ) -> None:
         self.subs_path = Path(subs_path)
         self.pool = pool
+        self.config = config
         # per-subscriber queue bound the HTTP layer attaches with; the
         # slow-consumer policy (matcher.py) makes this a hard memory cap
-        self.queue_size = queue_size or SUBSCRIBER_QUEUE_SIZE
+        self.queue_size = queue_size or (
+            config.subscriber_queue_size
+            if config is not None
+            else SUBSCRIBER_QUEUE_SIZE
+        )
         self.by_id: Dict[str, Matcher] = {}
         self.by_sql: Dict[str, Matcher] = {}
         self._lock = asyncio.Lock()
         self._gc_task: Optional[asyncio.Task] = None
+        # vectorized device matcher (pubsub/vmatch): opt-in via config or
+        # the explicit flag; import is lazy so the serving plane stays
+        # jax-free when disabled
+        if vmatch is None:
+            vmatch = bool(getattr(config, "vectorized_matcher", False))
+        self._vmatch_enabled = vmatch
+        self._router = None
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
         self._gc_task = asyncio.create_task(self._gc_loop(), name="subs-gc")
+        if self._vmatch_enabled and self._router is None:
+            try:
+                from .matcher import (
+                    CANDIDATE_BATCH_MAX,
+                    CANDIDATE_BATCH_WINDOW,
+                )
+                from .vmatch.route import VmatchRouter
+
+                cfg = self.config
+                self._router = VmatchRouter(
+                    self,
+                    batch_max=(
+                        cfg.candidate_batch_max if cfg else CANDIDATE_BATCH_MAX
+                    ),
+                    batch_window=(
+                        cfg.candidate_batch_window
+                        if cfg
+                        else CANDIDATE_BATCH_WINDOW
+                    ),
+                    chunk=getattr(cfg, "vmatch_chunk", 128) if cfg else 128,
+                )
+                for matcher in self.by_id.values():
+                    self._router.add(matcher)
+                self._router.start()
+            except Exception:
+                logger.exception(
+                    "vectorized matcher unavailable; using interpreted walk"
+                )
+                self._router = None
 
     async def stop(self) -> None:
         await cancel_and_wait(self._gc_task)
         self._gc_task = None
+        if self._router is not None:
+            await self._router.stop()
+            self._router = None
         for matcher in list(self.by_id.values()):
             await matcher.stop()
         self.by_id.clear()
@@ -101,11 +150,14 @@ class SubsManager:
                 if not sub_id or not sql_text:
                     continue
                 matcher = await Matcher.create(
-                    sub_id, sql_text, sub_dir, self.pool, restore=True
+                    sub_id, sql_text, sub_dir, self.pool, restore=True,
+                    config=self.config,
                 )
                 matcher.start()
                 self.by_id[sub_id] = matcher
                 self.by_sql[matcher.normalized] = matcher
+                if self._router is not None:
+                    self._router.add(matcher)
                 restored += 1
             except Exception:
                 logger.exception("failed to restore subscription from %s", sub_dir)
@@ -128,11 +180,14 @@ class SubsManager:
                 asyncio.ensure_future(existing.stop())
             sub_id = str(uuid.uuid4())
             matcher = await Matcher.create(
-                sub_id, sql_text, self.subs_path / sub_id, self.pool
+                sub_id, sql_text, self.subs_path / sub_id, self.pool,
+                config=self.config,
             )
             matcher.start()
             self.by_id[sub_id] = matcher
             self.by_sql[normalized] = matcher
+            if self._router is not None:
+                self._router.add(matcher)
             return matcher, True
 
     def get(self, sub_id: str) -> Optional[Matcher]:
@@ -154,6 +209,8 @@ class SubsManager:
                 return False
             self.by_id.pop(sub_id, None)
             self.by_sql.pop(matcher.normalized, None)
+            if self._router is not None:
+                self._router.discard(sub_id)
         await matcher.stop()
         with contextlib.suppress(OSError):
             shutil.rmtree(matcher.sub_dir)
@@ -180,6 +237,11 @@ class SubsManager:
         for _actor, changeset in applied:
             changes.extend(getattr(changeset, "changes", ()))
         if not changes:
+            return
+        if self._router is not None:
+            # vectorized path: batch under the candidate window, run the
+            # device matcher, touch only matched subscriptions
+            self._router.enqueue(changes)
             return
         for matcher in self.by_id.values():
             matcher.filter_changes(changes)

@@ -73,10 +73,18 @@ class Subscriber:
     queue: asyncio.Queue
     closed: bool = False
     lagging: bool = False  # above the lag watermark (counted once per episode)
+    # fraction of the queue bound counting as "lagging"; None reads the
+    # module default at use time (tests monkeypatch the module constant)
+    lag_watermark: Optional[float] = None
 
     @property
     def watermark(self) -> int:
-        return max(1, int(self.queue.maxsize * SUBSCRIBER_LAG_WATERMARK))
+        frac = (
+            self.lag_watermark
+            if self.lag_watermark is not None
+            else SUBSCRIBER_LAG_WATERMARK
+        )
+        return max(1, int(self.queue.maxsize * frac))
 
     def push(self, event: dict) -> None:
         try:
@@ -121,7 +129,9 @@ class Matcher:
         trigger_tables: Set[str],
         sub_dir: Path,
         pool,
+        config=None,  # types.config.PubsubConfig; None = module defaults
     ) -> None:
+        self.config = config
         self.id = id
         self.sql = sql_text
         self.normalized = normalized
@@ -156,9 +166,22 @@ class Matcher:
 
     # -- setup -------------------------------------------------------------
 
+    def _cfg(self, name: str, default_name: str):
+        """Config value when a PubsubConfig is threaded through, else the
+        module constant — read dynamically so tests can monkeypatch it."""
+        if self.config is not None:
+            return getattr(self.config, name)
+        return globals()[default_name]
+
     @classmethod
     async def create(
-        cls, id: str, sql_text: str, sub_dir: Path, pool, restore: bool = False
+        cls,
+        id: str,
+        sql_text: str,
+        sub_dir: Path,
+        pool,
+        restore: bool = False,
+        config=None,
     ) -> "Matcher":
         """Parse + validate the query against the live schema and build the
         matcher (ref: Matcher::create / restore, pubsub.rs:509-925,773-809)."""
@@ -205,6 +228,7 @@ class Matcher:
             trigger_tables=triggers,
             sub_dir=Path(sub_dir),
             pool=pool,
+            config=config,
         )
 
         # the PK-injected rewrite must itself compile — catching rewrite
@@ -331,7 +355,15 @@ class Matcher:
         ``queue_size`` overrides the bound (tests and the loadgen shrink
         it to exercise the slow-consumer policy without 1024 events)."""
         sub = Subscriber(
-            queue=asyncio.Queue(maxsize=queue_size or SUBSCRIBER_QUEUE_SIZE)
+            queue=asyncio.Queue(
+                maxsize=queue_size
+                or self._cfg("subscriber_queue_size", "SUBSCRIBER_QUEUE_SIZE")
+            ),
+            lag_watermark=(
+                self.config.subscriber_lag_watermark
+                if self.config is not None
+                else None
+            ),
         )
         self._subs.append(sub)
         self.last_seen = time.monotonic()
@@ -452,7 +484,9 @@ class Matcher:
             while True:
                 batch, full = await self._gather_candidates()
                 await self._diff_pass(batch, full)
-                if time.monotonic() - self._last_purge > PURGE_INTERVAL:
+                if time.monotonic() - self._last_purge > self._cfg(
+                    "purge_interval", "PURGE_INTERVAL"
+                ):
                     await asyncio.to_thread(self._purge_changes)
                     self._last_purge = time.monotonic()
         except asyncio.CancelledError:
@@ -470,9 +504,12 @@ class Matcher:
         merged: Dict[str, Set[bytes]] = {
             t: set(pks) for t, pks in cands.items()
         }
-        deadline = asyncio.get_running_loop().time() + CANDIDATE_BATCH_WINDOW
+        deadline = asyncio.get_running_loop().time() + self._cfg(
+            "candidate_batch_window", "CANDIDATE_BATCH_WINDOW"
+        )
         total = sum(len(v) for v in merged.values())
-        while total < CANDIDATE_BATCH_MAX:
+        batch_max = self._cfg("candidate_batch_max", "CANDIDATE_BATCH_MAX")
+        while total < batch_max:
             timeout = deadline - asyncio.get_running_loop().time()
             if timeout <= 0:
                 break
@@ -743,6 +780,6 @@ class Matcher:
             conn.execute(
                 "DELETE FROM changes WHERE id <= "
                 "(SELECT MAX(id) FROM changes) - ?",
-                (CHANGES_RETENTION,),
+                (self._cfg("changes_retention", "CHANGES_RETENTION"),),
             )
             conn.commit()

@@ -1,0 +1,46 @@
+"""Vectorized subscription matcher (the serving-plane analogue of the
+sim's bitpacked planes + segment-reduce playbook).
+
+``compile.py`` lowers each standing subscription's WHERE/FROM shape
+(``pubsub/sql.py``'s ``ParsedSelect``) into a fixed-width predicate
+program — opcode/operand rows over a shared constant pool and
+primary-key column-slot space — padded into ``[S, P]`` device arrays.
+``eval.py`` evaluates ALL programs against a ``[C]`` change batch in one
+jitted program (gather change pk columns → vectorized opcode
+interpreter via masked select → segment-reduce per-subscription match
+bits).  ``route.py`` is the ``SubsManager`` front end: it batches
+incoming changes under the candidate aggregation window, runs the
+device matcher, and only touches matched subscriptions' ``sub.sqlite``.
+
+The device program is a *sound over-approximation*: it evaluates the
+predicate in Kleene three-valued logic with only the change's primary
+key known (everything else is UNKNOWN), so a subscription is pruned
+only when its predicate is *definitely false* for the changed row.  The
+SQLite diff pass remains the always-correct oracle — predicates the
+compiler can't lower (IN-subqueries, multi-table joins, functions)
+simply never prune and are counted in ``corro.match.fallback_subs``.
+"""
+
+from .compile import (
+    MAX_PROG,
+    MAX_STACK,
+    MAX_TABLES,
+    ProgramSet,
+    SubProgram,
+    Unsupported,
+    compile_sub,
+    encode_value,
+    py_eval,
+)
+
+__all__ = [
+    "MAX_PROG",
+    "MAX_STACK",
+    "MAX_TABLES",
+    "ProgramSet",
+    "SubProgram",
+    "Unsupported",
+    "compile_sub",
+    "encode_value",
+    "py_eval",
+]

@@ -1,0 +1,738 @@
+"""Lower standing subscription predicates into fixed-width device programs.
+
+Each subscription's WHERE clause becomes a postfix (RPN) instruction
+list over a tiny opcode set, evaluated in Kleene three-valued logic
+(FALSE=0, UNKNOWN=1, TRUE=2 — AND=min, OR=max, NOT=2-x, which is
+exactly SQL NULL semantics).  Only the changed row's PRIMARY KEY is
+known at match time, so:
+
+* atoms over pk columns compare exactly (the pk is the row identity and
+  cr-sqlite treats pk updates as delete+insert, so a pk-atom verdict
+  holds for the row's whole lifetime);
+* atoms over any other column push UNKNOWN (the old row may have
+  matched even if the new cell doesn't — only the SQLite diff knows);
+* a subscription is pruned only when the whole predicate evaluates to
+  *definitely false* — UNKNOWN keeps it a candidate.
+
+Values are encoded into a (class, 64-bit order key, exact) triple whose
+order matches SQLite's cross-type collation (NULL < numeric < text <
+blob; numerics in double space; text/blob by 8-byte big-endian prefix).
+``exact`` marks keys whose equality implies value equality — inexact
+keys (long strings sharing a prefix, ints beyond 2^53) degrade equal
+comparisons to UNKNOWN instead of lying.
+
+Shapes the compiler can't lower (multi-table FROM, IN-subqueries,
+functions, arithmetic) mark the subscription as fallback: it is routed
+purely by trigger-table membership — byte-identical behaviour to the
+interpreted ``Matcher.filter_changes`` walk — and counted in
+``corro.match.fallback_subs``.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..sql import ParsedSelect, Token, tokenize, unquote_ident
+
+# -- program format ---------------------------------------------------------
+
+VMATCH_FORMAT = 1  # bump on any opcode/encoding change (AOT cache key)
+
+MAX_PROG = 32  # instructions per program; longer predicates fall back
+MAX_STACK = 8  # operand stack depth; deeper nesting falls back
+MAX_TABLES = 8  # trigger tables per subscription in the routing planes
+
+OP_NOP = 0  # padding: leaves the stack untouched
+OP_PUSH_T = 1  # push TRUE (empty WHERE, fallback rows)
+OP_PUSH_U = 2  # push UNKNOWN (atom over a non-pk column)
+OP_AND = 3
+OP_OR = 4
+OP_NOT = 5
+OP_LT = 6
+OP_LE = 7
+OP_GT = 8
+OP_GE = 9
+OP_EQ = 10
+OP_NE = 11
+OP_ISNULL = 12
+OP_NOTNULL = 13
+N_OPS = 14
+
+_CMP_OPS = {"<": OP_LT, "<=": OP_LE, ">": OP_GT, ">=": OP_GE,
+            "=": OP_EQ, "==": OP_EQ, "!=": OP_NE, "<>": OP_NE}
+_MIRROR = {OP_LT: OP_GT, OP_LE: OP_GE, OP_GT: OP_LT, OP_GE: OP_LE,
+           OP_EQ: OP_EQ, OP_NE: OP_NE}
+
+TRI_F = 0
+TRI_U = 1
+TRI_T = 2
+
+CLS_NULL = 0
+CLS_NUM = 1
+CLS_TEXT = 2
+CLS_BLOB = 3
+
+_I64_BIAS = 1 << 63
+_MASK64 = (1 << 64) - 1
+
+
+class Unsupported(Exception):
+    """Predicate shape the compiler can't lower (the sub falls back)."""
+
+
+# -- value encoding ---------------------------------------------------------
+
+
+def _f64_okey(f: float) -> int:
+    """Monotone map from float64 to signed int64 (ordered double bits)."""
+    if f != f:  # NaN never stores in SQLite; collate it below everything
+        return -_I64_BIAS
+    if f == 0.0:
+        f = 0.0  # -0.0 == 0.0 in SQL; fold to one key
+    (u,) = struct.unpack("<Q", struct.pack("<d", f))
+    if u >> 63:
+        u = (~u) & _MASK64
+    else:
+        u |= _I64_BIAS
+    return u - _I64_BIAS
+
+
+def _prefix_okey(b: bytes) -> int:
+    """First 8 bytes, big-endian, zero-padded: byte-lexicographic order."""
+    return int.from_bytes((b[:8] + b"\x00" * 8)[:8], "big") - _I64_BIAS
+
+
+def _prefix_exact(b: bytes) -> bool:
+    # the zero-padded prefix is injective only for values that are their
+    # own stripped form: <= 8 bytes with no trailing NUL (b"a" and
+    # b"a\x00" share a key; marking the padded one inexact keeps EQ honest)
+    return len(b) <= 8 and (len(b) == 0 or b[-1] != 0)
+
+
+def encode_value(v: Any) -> Tuple[int, int, bool]:
+    """Encode one SQL value as ``(cls, okey, exact)``.
+
+    Ordering of ``(cls, okey)`` tuples matches SQLite collation across
+    every pair of encodable values; ``exact`` guards equality."""
+    if v is None:
+        return (CLS_NULL, 0, True)
+    if isinstance(v, bool):
+        v = int(v)
+    if isinstance(v, int):
+        try:
+            f = float(v)
+        except OverflowError:
+            f = float("inf") if v > 0 else float("-inf")
+        return (CLS_NUM, _f64_okey(f), int(f) == v if f == f else False)
+    if isinstance(v, float):
+        return (CLS_NUM, _f64_okey(v), v == v)
+    if isinstance(v, str):
+        b = v.encode("utf-8", "surrogatepass")
+        return (CLS_TEXT, _prefix_okey(b), _prefix_exact(b))
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        b = bytes(v)
+        return (CLS_BLOB, _prefix_okey(b), _prefix_exact(b))
+    raise Unsupported(f"unencodable literal type {type(v).__name__}")
+
+
+def tri_cmp(op: int, a: Tuple[int, int, bool], b: Tuple[int, int, bool]) -> int:
+    """Host reference of the device comparison (used by py_eval + tests)."""
+    acls, aokey, aexact = a
+    bcls, bokey, bexact = b
+    if acls == CLS_NULL or bcls == CLS_NULL:
+        return TRI_U  # SQL: comparisons against NULL are NULL
+    if op == OP_ISNULL:
+        return TRI_F
+    if op == OP_NOTNULL:
+        return TRI_T
+    if (acls, aokey) < (bcls, bokey):
+        ordc = -1
+    elif (acls, aokey) > (bcls, bokey):
+        ordc = 1
+    else:
+        ordc = 0
+    eq_certain = ordc == 0 and acls == bcls and aexact and bexact
+    lt_v, eq_v, gt_v = {
+        OP_LT: (TRI_T, TRI_F, TRI_F),
+        OP_LE: (TRI_T, TRI_T, TRI_F),
+        OP_GT: (TRI_F, TRI_F, TRI_T),
+        OP_GE: (TRI_F, TRI_T, TRI_T),
+        OP_EQ: (TRI_F, TRI_T, TRI_F),
+        OP_NE: (TRI_T, TRI_F, TRI_T),
+    }[op]
+    if ordc < 0:
+        return lt_v
+    if ordc > 0:
+        return gt_v
+    return eq_v if eq_certain else TRI_U
+
+
+# -- per-subscription programs ----------------------------------------------
+
+
+@dataclass
+class SubProgram:
+    """One subscription's lowered predicate (host form, pre-stacking)."""
+
+    sub_id: str
+    tables: Tuple[str, ...]  # all trigger tables (candidate on any change)
+    table: Optional[str]  # the lowered FROM table, None when fallback
+    n_pk: int  # pk arity of the lowered table (0 when fallback)
+    ops: List[int] = field(default_factory=list)
+    cols: List[int] = field(default_factory=list)  # pk index within table
+    consts: List[int] = field(default_factory=list)  # local const pool idx
+    dsts: List[int] = field(default_factory=list)  # precomputed stack slot
+    const_values: List[Tuple[int, int, bool]] = field(default_factory=list)
+    lowered: bool = True
+    reason: str = ""  # why fallback, for diagnostics
+
+    def py_result(self, pk_enc: Sequence[Tuple[int, int, bool]]) -> int:
+        """Reference stack-machine evaluation (device-semantics twin)."""
+        stack = [TRI_F] * MAX_STACK
+        for op, col, cidx, dst in zip(self.ops, self.cols, self.consts, self.dsts):
+            if op == OP_NOP:
+                continue
+            if op == OP_PUSH_T:
+                stack[dst] = TRI_T
+            elif op == OP_PUSH_U:
+                stack[dst] = TRI_U
+            elif op == OP_AND:
+                stack[dst] = min(stack[dst], stack[dst + 1])
+            elif op == OP_OR:
+                stack[dst] = max(stack[dst], stack[dst + 1])
+            elif op == OP_NOT:
+                stack[dst] = 2 - stack[dst]
+            elif op in (OP_ISNULL, OP_NOTNULL):
+                if col >= len(pk_enc):
+                    stack[dst] = TRI_U
+                else:
+                    isnull = pk_enc[col][0] == CLS_NULL
+                    stack[dst] = (
+                        TRI_T if isnull == (op == OP_ISNULL) else TRI_F
+                    )
+            else:  # comparison
+                if col >= len(pk_enc):
+                    stack[dst] = TRI_U
+                else:
+                    stack[dst] = tri_cmp(
+                        op, pk_enc[col], self.const_values[cidx]
+                    )
+        return stack[0]
+
+
+def py_eval(prog: SubProgram, table: str, pk_values: Sequence[Any]) -> bool:
+    """Host oracle: is this subscription a candidate for a change to
+    ``table`` with primary key ``pk_values``?  Mirrors the device program
+    bit-for-bit (the ≥20-draw parity matrix in tests/test_vmatch.py
+    asserts this)."""
+    if table not in prog.tables:
+        return False
+    if not prog.lowered or table != prog.table:
+        return True
+    pk_enc = [encode_value(v) for v in pk_values]
+    return prog.py_result(pk_enc) != TRI_F
+
+
+# -- WHERE-clause expression parser -----------------------------------------
+
+
+class _Ast:
+    __slots__ = ("kind", "a", "b", "op", "col", "val")
+
+    def __init__(self, kind, a=None, b=None, op=None, col=None, val=None):
+        self.kind, self.a, self.b = kind, a, b
+        self.op, self.col, self.val = op, col, val
+
+
+class _Parser:
+    """Pratt-ish recursive-descent over the WHERE token slice."""
+
+    def __init__(self, tokens: List[Token], pk_index: Dict[str, int],
+                 table_names: Set[str]):
+        self.toks = tokens
+        self.i = 0
+        self.pk_index = pk_index  # lowercased pk column name -> pk index
+        self.table_names = table_names  # lowercased {name, alias}
+
+    def peek(self) -> Optional[Token]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> Token:
+        t = self.peek()
+        if t is None:
+            raise Unsupported("unexpected end of WHERE clause")
+        self.i += 1
+        return t
+
+    def at_word(self, *words: str) -> bool:
+        t = self.peek()
+        return t is not None and t.kind == "word" and t.upper in words
+
+    def eat_word(self, *words: str) -> bool:
+        if self.at_word(*words):
+            self.i += 1
+            return True
+        return False
+
+    def expect_punct(self, text: str) -> None:
+        t = self.next()
+        if t.text != text:
+            raise Unsupported(f"expected {text!r}, got {t.text!r}")
+
+    # expression grammar: OR < AND < NOT < atom
+    def parse(self) -> _Ast:
+        node = self.or_expr()
+        if self.peek() is not None:
+            raise Unsupported(f"trailing tokens at {self.peek().text!r}")
+        return node
+
+    def or_expr(self) -> _Ast:
+        node = self.and_expr()
+        while self.eat_word("OR"):
+            node = _Ast("or", node, self.and_expr())
+        return node
+
+    def and_expr(self) -> _Ast:
+        node = self.not_expr()
+        while self.eat_word("AND"):
+            node = _Ast("and", node, self.not_expr())
+        return node
+
+    def not_expr(self) -> _Ast:
+        if self.eat_word("NOT"):
+            return _Ast("not", self.not_expr())
+        return self.primary()
+
+    def primary(self) -> _Ast:
+        t = self.peek()
+        if t is not None and t.text == "(":
+            self.i += 1
+            node = self.or_expr()
+            self.expect_punct(")")
+            return node
+        return self.atom()
+
+    # -- atoms --------------------------------------------------------------
+
+    def _read_cmp(self) -> Optional[int]:
+        """Merge adjacent single-char punct tokens into one operator (the
+        shared tokenizer splits '<=' into '<' '=')."""
+        t = self.peek()
+        if t is None or t.kind != "punct":
+            return None
+        text = t.text
+        j = self.i + 1
+        while j < len(self.toks):
+            nxt = self.toks[j]
+            if (nxt.kind == "punct"
+                    and nxt.pos == self.toks[j - 1].pos + len(self.toks[j - 1].text)
+                    and (text + nxt.text) in _CMP_OPS):
+                text += nxt.text
+                j += 1
+            else:
+                break
+        if text not in _CMP_OPS:
+            return None
+        self.i = j
+        return _CMP_OPS[text]
+
+    def _try_column(self) -> Optional[Optional[int]]:
+        """Parse a column reference.  Returns the pk index, or None for a
+        known non-pk / unresolvable column, or raises to backtrack."""
+        t = self.peek()
+        if t is None or t.kind not in ("word", "qident"):
+            return None
+        if t.kind == "word" and t.upper in ("NULL", "TRUE", "FALSE"):
+            return None
+        save = self.i
+        first = self.next()
+        name = unquote_ident(first.text).lower()
+        nxt = self.peek()
+        if nxt is not None and nxt.text == ".":
+            self.i += 1
+            colt = self.next()
+            if colt.kind not in ("word", "qident"):
+                self.i = save
+                raise Unsupported(f"bad column reference at {colt.text!r}")
+            qualifier, name = name, unquote_ident(colt.text).lower()
+            if qualifier not in self.table_names:
+                # unknown qualifier: not our FROM table, never prunes
+                return -1
+        # a bare word followed by '(' is a function call, not a column
+        nxt = self.peek()
+        if nxt is not None and nxt.text == "(":
+            raise Unsupported(f"function call {name!r}() in WHERE")
+        return self.pk_index.get(name, -1)
+
+    def _literal(self) -> Any:
+        t = self.next()
+        if t.kind == "num":
+            txt = t.text
+            if txt.isdigit():
+                return int(txt)
+            return float(txt)
+        if t.kind == "str":
+            return t.text[1:-1].replace("''", "'")
+        if t.kind == "word":
+            up = t.upper
+            if up == "NULL":
+                return None
+            if up == "TRUE":
+                return 1
+            if up == "FALSE":
+                return 0
+            if up == "X":
+                nxt = self.peek()
+                if (nxt is not None and nxt.kind == "str"
+                        and nxt.pos == t.pos + 1):
+                    self.i += 1
+                    hexstr = nxt.text[1:-1]
+                    try:
+                        return bytes.fromhex(hexstr)
+                    except ValueError:
+                        raise Unsupported(f"bad blob literal X'{hexstr}'")
+            raise Unsupported(f"unsupported operand {t.text!r}")
+        if t.kind == "punct" and t.text in ("+", "-"):
+            v = self._literal()
+            if not isinstance(v, (int, float)):
+                raise Unsupported("sign on non-numeric literal")
+            return -v if t.text == "-" else v
+        raise Unsupported(f"unsupported operand {t.text!r}")
+
+    def _is_literal_start(self) -> bool:
+        t = self.peek()
+        if t is None:
+            return False
+        if t.kind in ("num", "str"):
+            return True
+        if t.kind == "word" and t.upper in ("NULL", "TRUE", "FALSE"):
+            return True
+        if t.kind == "word" and t.upper == "X":
+            # blob literal X'..' only when the quote is adjacent —
+            # otherwise this is a column named x
+            nxt = (self.toks[self.i + 1]
+                   if self.i + 1 < len(self.toks) else None)
+            return (nxt is not None and nxt.kind == "str"
+                    and nxt.pos == t.pos + 1)
+        return t.kind == "punct" and t.text in ("+", "-")
+
+    def atom(self) -> _Ast:
+        # literal-first form: 5 < id
+        if self._is_literal_start():
+            lit = self._literal()
+            op = self._read_cmp()
+            if op is None:
+                raise Unsupported("literal without comparison")
+            col = self._try_column()
+            if col is None:
+                if self._is_literal_start():
+                    self._literal()  # lit cmp lit: constant, can't prune
+                    return _Ast("unknown")
+                raise Unsupported("comparison without column operand")
+            return _Ast("cmp", op=_MIRROR[op], col=col, val=lit)
+
+        col = self._try_column()
+        if col is None:
+            t = self.peek()
+            raise Unsupported(
+                f"unsupported atom at {t.text!r}" if t else "empty atom"
+            )
+
+        # IS [NOT] NULL
+        if self.eat_word("IS"):
+            neg = self.eat_word("NOT")
+            if not self.eat_word("NULL"):
+                raise Unsupported("IS without NULL")
+            return _Ast("isnull", op=OP_NOTNULL if neg else OP_ISNULL, col=col)
+
+        neg = self.eat_word("NOT")
+
+        # [NOT] BETWEEN lo AND hi
+        if self.eat_word("BETWEEN"):
+            lo = self._literal()
+            if not self.eat_word("AND"):
+                raise Unsupported("BETWEEN without AND")
+            hi = self._literal()
+            node = _Ast(
+                "and",
+                _Ast("cmp", op=OP_GE, col=col, val=lo),
+                _Ast("cmp", op=OP_LE, col=col, val=hi),
+            )
+            return _Ast("not", node) if neg else node
+
+        # [NOT] IN (literal, ...)
+        if self.eat_word("IN"):
+            self.expect_punct("(")
+            if self.at_word("SELECT"):
+                raise Unsupported("IN subquery")
+            node: Optional[_Ast] = None
+            while True:
+                item = _Ast("cmp", op=OP_EQ, col=col, val=self._literal())
+                node = item if node is None else _Ast("or", node, item)
+                t = self.next()
+                if t.text == ")":
+                    break
+                if t.text != ",":
+                    raise Unsupported(f"bad IN list at {t.text!r}")
+            return _Ast("not", node) if neg else node
+
+        if neg:
+            raise Unsupported("NOT without BETWEEN/IN")
+
+        op = self._read_cmp()
+        if op is None:
+            t = self.peek()
+            raise Unsupported(
+                f"column without comparison at {t.text!r}" if t
+                else "column without comparison"
+            )
+        if self._is_literal_start():
+            return _Ast("cmp", op=op, col=col, val=self._literal())
+        other = self._try_column()
+        if other is not None:
+            return _Ast("unknown")  # column-to-column: can't prune
+        raise Unsupported("comparison without literal operand")
+
+
+# -- AST → RPN emission -----------------------------------------------------
+
+
+class _Emitter:
+    def __init__(self):
+        self.prog = SubProgram(sub_id="", tables=(), table=None, n_pk=0)
+        self._pool: Dict[Tuple[int, int, bool], int] = {}
+
+    def _const(self, v: Any) -> int:
+        enc = encode_value(v)
+        idx = self._pool.get(enc)
+        if idx is None:
+            idx = len(self.prog.const_values)
+            self._pool[enc] = idx
+            self.prog.const_values.append(enc)
+        return idx
+
+    def _ins(self, op: int, dst: int, col: int = 0, cidx: int = 0) -> None:
+        if len(self.prog.ops) >= MAX_PROG:
+            raise Unsupported(f"predicate program exceeds {MAX_PROG} ops")
+        self.prog.ops.append(op)
+        self.prog.cols.append(col)
+        self.prog.consts.append(cidx)
+        self.prog.dsts.append(dst)
+
+    def emit(self, node: _Ast, depth: int = 0) -> None:
+        if depth + 1 > MAX_STACK:
+            raise Unsupported(f"predicate nests deeper than {MAX_STACK}")
+        if node.kind == "and" or node.kind == "or":
+            self.emit(node.a, depth)
+            self.emit(node.b, depth + 1)
+            self._ins(OP_AND if node.kind == "and" else OP_OR, depth)
+        elif node.kind == "not":
+            self.emit(node.a, depth)
+            self._ins(OP_NOT, depth)
+        elif node.kind == "true":
+            self._ins(OP_PUSH_T, depth)
+        elif node.kind == "unknown":
+            self._ins(OP_PUSH_U, depth)
+        elif node.kind == "isnull":
+            if node.col is None or node.col < 0:
+                self._ins(OP_PUSH_U, depth)
+            else:
+                self._ins(node.op, depth, col=node.col)
+        elif node.kind == "cmp":
+            if node.col is None or node.col < 0:
+                self._ins(OP_PUSH_U, depth)
+            else:
+                self._ins(node.op, depth, col=node.col,
+                          cidx=self._const(node.val))
+        else:  # pragma: no cover - parser produces no other kinds
+            raise Unsupported(f"unknown AST node {node.kind!r}")
+
+
+def compile_sub(
+    sub_id: str,
+    parsed: ParsedSelect,
+    pks: Sequence[Sequence[str]],
+    trigger_tables: Set[str],
+) -> SubProgram:
+    """Lower one subscription.  Never raises: unlowerable shapes return a
+    fallback program (table routing only, ``reason`` says why)."""
+    tables = tuple(sorted(trigger_tables))
+
+    def fallback(reason: str) -> SubProgram:
+        p = SubProgram(sub_id=sub_id, tables=tables, table=None, n_pk=0,
+                       lowered=False, reason=reason)
+        p.ops, p.cols, p.consts, p.dsts = [OP_PUSH_T], [0], [0], [0]
+        return p
+
+    if len(parsed.tables) != 1:
+        return fallback("multi-table FROM")
+    if parsed.has_outer_join:
+        return fallback("outer join")
+
+    ref = parsed.tables[0]
+    pk_cols = list(pks[0]) if pks else []
+    if not pk_cols:
+        return fallback("no primary key")
+
+    emitter = _Emitter()
+    if not parsed.has_where:
+        emitter.emit(_Ast("true"))
+    else:
+        where_src = parsed.sql[parsed.where_clause_start:parsed.where_insert]
+        try:
+            toks = [t for t in tokenize(parsed.sql)
+                    if parsed.where_clause_start <= t.pos < parsed.where_insert]
+            if not toks:
+                emitter.emit(_Ast("true"))
+            else:
+                pk_index = {c.lower(): i for i, c in enumerate(pk_cols)}
+                names = {ref.name.lower()}
+                if ref.alias:
+                    names.add(ref.alias.lower())
+                ast = _Parser(toks, pk_index, names).parse()
+                emitter.emit(ast)
+        except Unsupported as e:
+            fb = fallback(str(e))
+            fb.reason = f"{e} (WHERE {where_src.strip()[:60]!r})"
+            return fb
+
+    prog = emitter.prog
+    prog.sub_id = sub_id
+    prog.tables = tables
+    prog.table = ref.name
+    prog.n_pk = len(pk_cols)
+    return prog
+
+
+# -- stacking into device planes --------------------------------------------
+
+
+class ProgramSet:
+    """All compiled subscriptions stacked into dense numpy planes, ready
+    for the jitted evaluator (``eval.py``)."""
+
+    def __init__(self, programs: Sequence[SubProgram]):
+        import numpy as np
+
+        self.subs: List[SubProgram] = list(programs)
+        S = len(self.subs)
+        self.n_compiled = sum(1 for p in self.subs if p.lowered)
+        self.n_fallback = S - self.n_compiled
+
+        # global table-id space over every trigger table
+        names: List[str] = []
+        for p in self.subs:
+            for t in p.tables:
+                if t not in names:
+                    names.append(t)
+        names.sort()
+        self.table_id: Dict[str, int] = {t: i for i, t in enumerate(names)}
+        self.table_names = names
+
+        # pk column slots, per lowered table
+        self.pk_arity: Dict[str, int] = {}
+        for p in self.subs:
+            if p.lowered and p.table is not None:
+                self.pk_arity[p.table] = max(
+                    self.pk_arity.get(p.table, 0), p.n_pk
+                )
+        self.slot_base: Dict[str, int] = {}
+        base = 0
+        for t in sorted(self.pk_arity):
+            self.slot_base[t] = base
+            base += self.pk_arity[t]
+        self.n_slots = max(1, base)
+
+        P = max(1, max((len(p.ops) for p in self.subs), default=1))
+        T = max(1, max((len(p.tables) for p in self.subs), default=1))
+        self.P, self.T = P, T
+        # deepest stack register any program touches (+1 for the b-side
+        # read of binary ops) — the evaluator's static register count
+        self.stack_depth = min(
+            MAX_STACK,
+            max(
+                2,
+                max((max(p.dsts) + 2 for p in self.subs if p.dsts), default=2),
+            ),
+        )
+
+        # shared constant pool
+        pool: Dict[Tuple[int, int, bool], int] = {}
+        const_rows: List[Tuple[int, int, bool]] = []
+        self.prog_op = np.zeros((S, P), dtype=np.int32)
+        self.prog_col = np.zeros((S, P), dtype=np.int32)
+        self.prog_const = np.zeros((S, P), dtype=np.int32)
+        self.prog_dst = np.zeros((S, P), dtype=np.int32)
+        self.sub_table = np.full((S,), -1, dtype=np.int32)
+        self.sub_tables = np.full((S, T), -1, dtype=np.int32)
+        for s, p in enumerate(self.subs):
+            for j, t in enumerate(p.tables):
+                self.sub_tables[s, j] = self.table_id[t]
+            if p.lowered and p.table is not None:
+                self.sub_table[s] = self.table_id[p.table]
+            remap: List[int] = []
+            for enc in p.const_values:
+                idx = pool.get(enc)
+                if idx is None:
+                    idx = len(const_rows)
+                    pool[enc] = idx
+                    const_rows.append(enc)
+                remap.append(idx)
+            sbase = self.slot_base.get(p.table, 0) if p.table else 0
+            n = len(p.ops)
+            self.prog_op[s, :n] = p.ops
+            self.prog_dst[s, :n] = p.dsts
+            for j in range(n):
+                self.prog_col[s, j] = sbase + p.cols[j]
+                self.prog_const[s, j] = remap[p.consts[j]] if remap else 0
+
+        K = max(1, len(const_rows))
+        self.const_cls = np.zeros((K,), dtype=np.int8)
+        self.const_hi = np.zeros((K,), dtype=np.int32)
+        self.const_lo = np.zeros((K,), dtype=np.uint32)
+        self.const_exact = np.zeros((K,), dtype=bool)
+        for k, (cls, okey, exact) in enumerate(const_rows):
+            self.const_cls[k] = cls
+            self.const_hi[k] = okey >> 32
+            self.const_lo[k] = okey & 0xFFFFFFFF
+            self.const_exact[k] = exact
+        self.n_consts = len(const_rows)
+
+    # -- change-batch encoding ---------------------------------------------
+
+    def encode_changes(self, changes: Sequence[Tuple[str, Sequence[Any]]]):
+        """Encode ``(table, pk_values)`` rows into the evaluator's change
+        planes.  Unknown tables get id -2 (never matches -1 padding)."""
+        import numpy as np
+
+        C = max(1, len(changes))
+        NS = self.n_slots
+        chg_table = np.full((C,), -2, dtype=np.int32)
+        chg_cls = np.zeros((C, NS), dtype=np.int8)
+        chg_hi = np.zeros((C, NS), dtype=np.int32)
+        chg_lo = np.zeros((C, NS), dtype=np.uint32)
+        chg_exact = np.zeros((C, NS), dtype=bool)
+        chg_known = np.zeros((C, NS), dtype=bool)
+        chg_valid = np.zeros((C,), dtype=bool)
+        for c, (table, pk_values) in enumerate(changes):
+            chg_table[c] = self.table_id.get(table, -2)
+            chg_valid[c] = True
+            base = self.slot_base.get(table)
+            if base is None:
+                continue
+            arity = self.pk_arity[table]
+            for j, v in enumerate(pk_values[:arity]):
+                try:
+                    cls, okey, exact = encode_value(v)
+                except Unsupported:
+                    continue  # slot stays unknown: sound
+                slot = base + j
+                chg_cls[c, slot] = cls
+                chg_hi[c, slot] = okey >> 32
+                chg_lo[c, slot] = okey & 0xFFFFFFFF
+                chg_exact[c, slot] = exact
+                chg_known[c, slot] = True
+        return (chg_table, chg_cls, chg_hi, chg_lo, chg_exact,
+                chg_known, chg_valid)

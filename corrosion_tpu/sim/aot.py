@@ -78,7 +78,8 @@ _FINGERPRINT: Optional[str] = None
 
 def code_fingerprint() -> str:
     """sha256 over the source files that define the lowered programs
-    (sim/, fleet/, chaos/lower.py).  Any edit to the step logic changes
+    (sim/, fleet/, pubsub/vmatch/, chaos/lower.py).  Any edit to the
+    step logic changes
     the fingerprint, so stale disk artifacts can never replay an old
     program against new code — the failure mode the persistent XLA cache
     avoids by hashing HLO, which we skip lowering to produce."""
@@ -88,7 +89,7 @@ def code_fingerprint() -> str:
     here = os.path.dirname(os.path.abspath(__file__))
     pkg = os.path.dirname(here)
     files: List[str] = []
-    for sub in ("sim", "fleet"):
+    for sub in ("sim", "fleet", os.path.join("pubsub", "vmatch")):
         base = os.path.join(pkg, sub)
         if os.path.isdir(base):
             files.extend(

@@ -65,6 +65,7 @@ TAG_CHAOS_DROP = 12  # per-(round, src, dst) link-drop decision (chaos/)
 TAG_CHAOS_DUP = 13  # per-(round, src, dst) link-duplicate decision (chaos/)
 TAG_SERVE = 14  # loadgen traffic schedule draws (harness/loadgen.py)
 TAG_SERVE_FAULT = 15  # serving-plane fault verdicts (chaos/runtime.py)
+TAG_SERVE_SUBS = 16  # synthetic subscription predicates (harness/loadgen.py)
 
 
 def py_mix(x: int) -> int:

@@ -135,6 +135,74 @@ class PerfConfig:
 
 
 @dataclass
+class PubsubConfig:
+    """Serving-plane (subscription matcher) tuning.
+
+    Defaults mirror the reference constants in ``pubsub/matcher.py``
+    (pubsub.rs candidate cap / 600 ms aggregation window / PR 11's
+    bounded-queue slow-consumer policy); a ``[pubsub]`` TOML section or
+    ``CORRO__PUBSUB__*`` env overrides let operators tune the plane
+    without editing source."""
+
+    # candidate aggregation (ref: pubsub.rs cap + 600 ms window)
+    candidate_batch_max: int = 500
+    candidate_batch_window: float = 0.6
+    # slow-consumer policy (PR 11): per-subscriber queue bound, lag
+    # watermark as a fraction of the bound
+    subscriber_queue_size: int = 1024
+    subscriber_lag_watermark: float = 0.5
+    # changes-log retention + purge cadence
+    changes_retention: int = 10_000
+    purge_interval: float = 300.0
+    # vectorized device matcher (pubsub/vmatch/): batch standing
+    # predicates into one jitted program; falls back per-subscription to
+    # the SQLite diff path for predicates the compiler can't lower
+    vectorized_matcher: bool = False
+    # change-batch chunk width [C] the eval program is padded to; one
+    # executable serves any batch size up to candidate_batch_max in
+    # ceil(batch / chunk) calls
+    vmatch_chunk: int = 128
+
+    def validate(self) -> None:
+        """Raise ValueError naming the first out-of-range field."""
+        if self.candidate_batch_max < 1:
+            raise ValueError(
+                f"pubsub.candidate_batch_max must be >= 1, got "
+                f"{self.candidate_batch_max}"
+            )
+        if self.candidate_batch_window < 0:
+            raise ValueError(
+                f"pubsub.candidate_batch_window must be >= 0, got "
+                f"{self.candidate_batch_window}"
+            )
+        if self.subscriber_queue_size < 2:
+            # < 2 cannot hold one event + the __closed sentinel
+            raise ValueError(
+                f"pubsub.subscriber_queue_size must be >= 2, got "
+                f"{self.subscriber_queue_size}"
+            )
+        if not (0.0 < self.subscriber_lag_watermark <= 1.0):
+            raise ValueError(
+                f"pubsub.subscriber_lag_watermark must be in (0, 1], got "
+                f"{self.subscriber_lag_watermark}"
+            )
+        if self.changes_retention < 1:
+            raise ValueError(
+                f"pubsub.changes_retention must be >= 1, got "
+                f"{self.changes_retention}"
+            )
+        if self.purge_interval < 0:
+            raise ValueError(
+                f"pubsub.purge_interval must be >= 0, got "
+                f"{self.purge_interval}"
+            )
+        if self.vmatch_chunk < 1:
+            raise ValueError(
+                f"pubsub.vmatch_chunk must be >= 1, got {self.vmatch_chunk}"
+            )
+
+
+@dataclass
 class AdminConfig:
     uds_path: Optional[str] = None
 
@@ -166,6 +234,7 @@ class Config:
     api: ApiConfig = field(default_factory=ApiConfig)
     gossip: GossipConfig = field(default_factory=GossipConfig)
     perf: PerfConfig = field(default_factory=PerfConfig)
+    pubsub: PubsubConfig = field(default_factory=PubsubConfig)
     admin: AdminConfig = field(default_factory=AdminConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     log: LogConfig = field(default_factory=LogConfig)

@@ -242,12 +242,12 @@ def run(p, state):
 
 
 def test_gl401_scoped_to_device_program_dirs():
-    """The donation pass runs over sim/, crdt/ and fleet/ — a jit in an
+    """The donation pass runs over the device-program dirs — a jit in an
     out-of-scope dir (say a doc example under agent/) is not the pass's
     business (DONATION_DIRS pins the scope)."""
     from corrosion_tpu.analysis import DONATION_DIRS
 
-    assert set(DONATION_DIRS) == {"sim", "crdt", "fleet"}
+    assert set(DONATION_DIRS) == {"sim", "crdt", "fleet", "pubsub/vmatch"}
 
 
 def test_gl401_suppressible_with_reason():

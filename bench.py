@@ -248,7 +248,12 @@ def run_fleet_bench(seed: int, scale: float, dev, cache_dir: str,
     whole fleet is one executable) and the fleet-vs-solo-sum ratio,
     where solo-sum is ONE measured cold solo run × 64 — every solo
     seed bakes into a distinct program, so a naive sweep would pay 64
-    compiles."""
+    compiles.
+
+    Returns THREE bench lines: the legacy full-batch leg, the fleet-v2
+    compacted leg (warm wall vs a warm solo-sum estimate, plus the
+    executed bucket schedule), and the open- vs closed-loop tuner
+    timing on one shared grid (fleet/tune.py closed_loop)."""
     from corrosion_tpu.fleet import batch, run as fleetrun
     from corrosion_tpu.sim import cluster, model
 
@@ -309,7 +314,7 @@ def run_fleet_bench(seed: int, scale: float, dev, cache_dir: str,
         )
     solo_sum = 64 * solo_total
     conv = res.bytes_to_convergence[res.converged]
-    return {
+    legacy_line = {
         "metric": f"sim_fleet_{p.n_nodes}n_config3_64x_wall",
         "value": round(fleet_total, 3),
         "unit": "s",
@@ -333,6 +338,113 @@ def run_fleet_bench(seed: int, scale: float, dev, cache_dir: str,
         "cache": "cold" if entries_added > 0 else "warm",
         "device": dev.platform,
     }
+
+    # ---- fleet v2: converged-lane compaction (ISSUE 18) --------------
+    # BENCH_r10's regression was WARM-vs-warm: once compiles are paid on
+    # both sides, the full-batch fleet pays every lane every round to
+    # the slowest lane while solo runs exit at their own convergence.
+    # Measure the warm marginal costs: one warm solo execute × 64 vs the
+    # compacted fleet's warm wall.
+    solo_warm = cluster.run(batch.lane_params(p_static, sweep, 0), aot=aot)
+    warm_solo_sum = 64 * solo_warm.wall_s
+    log(f"solo warm lane 0: execute={solo_warm.wall_s:.3f}s")
+    interval = 16
+    kw = dict(
+        n_rounds=horizon, aot=aot, compact=True,
+        compaction_interval=interval,
+    )
+    cold = fleetrun.run_fleet(p_static, sweep, **kw)
+    assert (cold.rounds == res.rounds).all(), (
+        "compacted fleet diverged from the legacy fleet's rounds"
+    )
+    warm = fleetrun.run_fleet(p_static, sweep, **kw)
+    st = warm.compaction
+    log(
+        f"fleet v2: cold compile={cold.compile_s:.2f}s warm "
+        f"wall={warm.wall_s:.3f}s segments={len(st.segments)} "
+        f"buckets={st.bucket_widths} saved={st.flop_rounds_saved} "
+        f"lane-rounds"
+    )
+    v2_line = {
+        "metric": f"sim_fleet_v2_{p.n_nodes}n_config3_64x_warm_wall",
+        "value": round(warm.wall_s, 3),
+        "unit": "s",
+        "fleet": True,
+        "fleet_v2": True,
+        "n_scenarios": warm.n_scenarios,
+        "converged": int(warm.converged.sum()),
+        "compaction_interval": interval,
+        "segments": len(st.segments),
+        "bucket_schedule": st.segments,
+        "bucket_widths": st.bucket_widths,
+        "lanes_compacted": st.lanes_compacted,
+        "flop_rounds_saved": st.flop_rounds_saved,
+        "cold_compile_s": round(cold.compile_s, 3),
+        "cold_wall_s": round(cold.wall_s, 3),
+        "legacy_warm_wall_s": round(res.wall_s, 3),
+        "solo_warm_s": round(solo_warm.wall_s, 4),
+        "warm_solo_sum_est_s": round(warm_solo_sum, 3),
+        "warm_vs_solo_sum": (
+            round(warm.wall_s / warm_solo_sum, 4) if warm_solo_sum else None
+        ),
+        "device": dev.platform,
+    }
+
+    # ---- closed-loop tuner vs the PR 6 open-loop tuner ---------------
+    # same grid both ways; the closed loop fits the regime from lane
+    # 0's flight record, bounds the scan at the fitted horizon, and
+    # runs its rungs compacted (fleet/tune.py closed_loop)
+    from corrosion_tpu.fleet.tune import closed_loop, tune
+    from corrosion_tpu.sim import flight
+
+    grid = dict(
+        fanouts=[2, 3], max_transmissions=[2, 3], sync_intervals=[3],
+        seeds_per_point=2, max_rungs=1,
+    )
+    t0 = time.perf_counter()
+    open_res = tune(p, aot=aot, **grid)
+    open_s = time.perf_counter() - t0
+    telemetry = flight.to_ndjson(
+        flight.record_run(
+            batch.lane_params(p_static, sweep, 0), n_rounds=horizon, aot=aot
+        ).flight
+    )
+    clr = closed_loop(telemetry, p, aot=aot, **grid)
+    log(
+        f"tuner: open-loop {open_s:.2f}s vs closed-loop "
+        f"{clr.wall_s:.2f}s (fitted horizon {clr.fit.horizon} vs "
+        f"max_rounds {p.max_rounds})"
+    )
+    tuner_line = {
+        "metric": f"fleet_tuner_closed_loop_{p.n_nodes}n_wall",
+        "value": round(clr.wall_s, 3),
+        "unit": "s",
+        "tuner": True,
+        "open_loop_s": round(open_s, 3),
+        "closed_loop_s": round(clr.wall_s, 3),
+        "closed_vs_open": round(clr.wall_s / open_s, 4) if open_s else None,
+        "fit_horizon": clr.fit.horizon,
+        "fit_write_rounds": clr.fit.write_rounds,
+        "fit_drop_ppm": clr.fit.drop_ppm,
+        "open_recommended": (
+            None if open_res.recommended is None
+            else [
+                open_res.recommended.fanout,
+                open_res.recommended.max_transmissions,
+                open_res.recommended.sync_interval,
+            ]
+        ),
+        "closed_recommended": (
+            None if clr.result.recommended is None
+            else [
+                clr.result.recommended.fanout,
+                clr.result.recommended.max_transmissions,
+                clr.result.recommended.sync_interval,
+            ]
+        ),
+        "device": dev.platform,
+    }
+    return [legacy_line, v2_line, tuner_line]
 
 
 def run_mesh_dryrun_bench() -> dict:
@@ -522,11 +634,11 @@ def main() -> None:
     framed = not args.dense
 
     if args.fleet:
-        out = run_fleet_bench(
+        for out in run_fleet_bench(
             args.seed, args.scale, dev, cache_dir,
             packed=packed, framed=framed, aot=aot,
-        )
-        print(json.dumps(out), flush=True)
+        ):
+            print(json.dumps(out), flush=True)
         log(
             f"total harness wall (incl. imports): "
             f"{time.perf_counter()-t_all:.2f}s"

@@ -47,7 +47,7 @@ from .schedule import (
     ChaosSchedule,
 )
 
-__all__ = ["LoweredChaos", "lower"]
+__all__ = ["LoweredChaos", "lower", "slice_planes"]
 
 _NEVER = 1 << 30  # revive round for down_rounds = -1 (explicit restart only)
 
@@ -172,6 +172,50 @@ class LoweredChaos:
         if self.skew is not None:
             out["skew_node_rounds"] = int((self.skew != 0).sum())
         return out
+
+
+def slice_planes(
+    planes: Dict[str, np.ndarray], start: int, length: int
+) -> Dict[str, np.ndarray]:
+    """Window a stacked plane dict (:meth:`LoweredChaos.stack`) to the
+    segment rounds ``[start, start + length)``.
+
+    The compacted fleet (fleet/run.py) re-batches surviving lanes every
+    ``compaction_interval`` rounds; shipping each segment only its plane
+    window keeps the per-segment operand bytes proportional to the
+    segment instead of the full horizon (``drop_ppm`` alone is
+    ``R·N²·4`` bytes per lane).  The returned dict carries a
+    ``round_offset`` int32[B] entry; ``sim/cluster.make_step`` rebases
+    its round-major gathers by it while every RNG draw stays keyed on
+    the absolute round riding the carry — the sliced segment program is
+    bit-identical to gathering the full stack (tests/test_sim_fleet.py).
+
+    ``part_side`` and ``seed`` have no round axis and pass through
+    unchanged.  Slicing an already-sliced dict is refused: offsets do
+    not compose (the window is always cut from the full-horizon stack).
+    """
+    if "round_offset" in planes:
+        raise ValueError(
+            "planes already carry a round_offset: slice each segment "
+            "from the full-horizon stack, offsets do not compose"
+        )
+    out: Dict[str, np.ndarray] = {}
+    for k, v in planes.items():
+        if k in ("part_side", "seed"):
+            out[k] = v
+            continue
+        # round-major: part_active [B, R], dead/die/restart [B, R, N],
+        # drop_ppm [B, R, N, N]
+        if v.shape[1] < start + length:
+            raise ValueError(
+                f"plane {k!r} horizon {v.shape[1]} < segment end "
+                f"{start + length}: lower the schedules with a horizon "
+                "covering the scanned rounds"
+            )
+        out[k] = v[:, start : start + length]
+    B = planes["part_active"].shape[0]
+    out["round_offset"] = np.full(B, start, dtype=np.int32)
+    return out
 
 
 def lower(sched: ChaosSchedule, horizon: Optional[int] = None) -> LoweredChaos:

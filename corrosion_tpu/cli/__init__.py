@@ -31,6 +31,7 @@ import json
 import signal
 import sys
 import time
+from pathlib import Path
 from typing import Any, List, Optional
 
 from ..types.config import Config
@@ -600,33 +601,109 @@ async def cmd_fleet(args) -> int:
             for k in range(args.scenarios)
         ]
         p_static, sweep = batch.split(scenarios)
-        res = fleetrun.run_fleet(p_static, sweep, aot=aot)
+        mesh = (
+            fleetrun.lanes_mesh(args.lanes_devices)
+            if args.lanes_devices
+            else None
+        )
+        res = fleetrun.run_fleet(
+            p_static,
+            sweep,
+            aot=aot,
+            compact=args.compact,
+            compaction_interval=args.compaction_interval,
+            mesh=mesh,
+        )
         fleetrun.publish_metrics(res)
         if args.out:
             fleetrun.write_artifact(res, args.out)
             print(f"wrote {args.out}", file=sys.stderr)
         conv = res.bytes_to_convergence[res.converged]
-        print(
-            _json.dumps(
-                {
-                    "n_scenarios": res.n_scenarios,
-                    "converged": int(res.converged.sum()),
-                    "rounds_min": int(res.rounds.min()),
-                    "rounds_max": int(res.rounds.max()),
-                    "bytes_to_convergence_min": (
-                        int(conv.min()) if conv.size else None
-                    ),
-                    "compile_s": round(res.compile_s, 3),
-                    "wall_s": round(res.wall_s, 3),
-                },
-                sort_keys=True,
-                indent=2,
-            )
-        )
+        summary = {
+            "n_scenarios": res.n_scenarios,
+            "converged": int(res.converged.sum()),
+            "rounds_min": int(res.rounds.min()),
+            "rounds_max": int(res.rounds.max()),
+            "bytes_to_convergence_min": (
+                int(conv.min()) if conv.size else None
+            ),
+            "compile_s": round(res.compile_s, 3),
+            "wall_s": round(res.wall_s, 3),
+        }
+        if res.compaction is not None:
+            summary["compaction"] = {
+                "segments": len(res.compaction.segments),
+                "lanes_compacted": res.compaction.lanes_compacted,
+                "bucket_widths": res.compaction.bucket_widths,
+                "flop_rounds_saved": res.compaction.flop_rounds_saved,
+            }
+        print(_json.dumps(summary, sort_keys=True, indent=2))
         return 0 if bool(res.converged.all()) else 1
 
     if args.fleet_cmd == "tune":
-        from ..fleet.tune import frontier_markdown, tune
+        from ..fleet.tune import (
+            closed_loop,
+            frontier_markdown,
+            tune,
+            write_recommendation,
+        )
+
+        if args.telemetry:
+            try:
+                text = Path(args.telemetry).read_text()
+            except OSError as e:
+                _die(f"cannot read --telemetry file: {e}")
+            clr = closed_loop(
+                text,
+                p,
+                fanouts=fanouts,
+                max_transmissions=mts,
+                sync_intervals=sis,
+                seeds_per_point=args.seeds_per_point,
+                eta=args.eta,
+                max_rungs=args.rungs,
+                compaction_interval=args.compaction_interval,
+                aot=aot,
+            )
+            res = clr.result
+            print(frontier_markdown(res))
+            if args.recommend_out:
+                write_recommendation(clr, args.recommend_out)
+                print(f"wrote {args.recommend_out}", file=sys.stderr)
+            fit = clr.fit
+            print(
+                _json.dumps(
+                    {
+                        "fit": {
+                            "source": fit.source,
+                            "n_nodes": fit.n_nodes,
+                            "n_changes": fit.n_changes,
+                            "write_rounds": fit.write_rounds,
+                            "drop_ppm": fit.drop_ppm,
+                            "horizon": fit.horizon,
+                        },
+                        "recommended": (
+                            None
+                            if res.recommended is None
+                            else {
+                                "fanout": res.recommended.fanout,
+                                "max_transmissions": (
+                                    res.recommended.max_transmissions
+                                ),
+                                "sync_interval": (
+                                    res.recommended.sync_interval
+                                ),
+                            }
+                        ),
+                        "rungs": res.rungs,
+                        "compiles": res.compiles,
+                        "wall_s": round(clr.wall_s, 3),
+                    },
+                    sort_keys=True,
+                    indent=2,
+                )
+            )
+            return 0 if res.recommended is not None else 1
 
         res = tune(
             p,
@@ -637,6 +714,8 @@ async def cmd_fleet(args) -> int:
             eta=args.eta,
             max_rungs=args.rungs,
             aot=aot,
+            compact=args.compact,
+            compaction_interval=args.compaction_interval,
         )
         print(frontier_markdown(res))
         if res.recommended is None:
@@ -941,11 +1020,23 @@ def build_parser() -> argparse.ArgumentParser:
                         help="serve/store AOT executable artifacts here "
                         "(sim/aot.py; repeat sweeps/rungs with the same "
                         "lane count reuse one executable)")
+        fp.add_argument("--compact", action="store_true",
+                        help="converged-lane compaction: drop finished "
+                        "lanes every --compaction-interval rounds and "
+                        "re-batch survivors at power-of-two widths "
+                        "(doc/simulator.md \"Fleet v2\")")
+        fp.add_argument("--compaction-interval", type=int, default=16,
+                        help="rounds per compaction segment (default 16)")
         if name == "run":
             fp.add_argument(
                 "--scenarios", type=int, default=8,
                 help="seeds per knob point (lanes = points × scenarios)",
             )
+            fp.add_argument("--lanes-devices", type=int, default=0,
+                            help="shard lanes across this many devices via "
+                            "a 1-D 'lanes' mesh (0 = no sharding; on CPU "
+                            "needs XLA_FLAGS="
+                            "--xla_force_host_platform_device_count=N)")
             fp.add_argument("-o", "--out", default=None,
                             help="write the FLEET_r*.json artifact here")
         else:
@@ -954,6 +1045,14 @@ def build_parser() -> argparse.ArgumentParser:
                             help="halving rate (keep top 1/eta per rung)")
             fp.add_argument("--rungs", type=int, default=3,
                             help="max successive-halving rungs")
+            fp.add_argument("--telemetry", default=None, metavar="PATH",
+                            help="closed-loop mode: fit the regime observed "
+                            "in this flight NDJSON or loadgen report JSON, "
+                            "then tune against the fitted regime "
+                            "(fleet/tune.py closed_loop)")
+            fp.add_argument("--recommend-out", default=None, metavar="PATH",
+                            help="with --telemetry: write the "
+                            "recommendation artifact here")
     sp.set_defaults(fn=cmd_fleet)
 
     sp = sub.add_parser("tls", help="certificate generation")

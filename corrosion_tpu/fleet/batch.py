@@ -170,3 +170,31 @@ def lane_params(p_static: SimParams, sweep: SweepParams, i: int) -> SimParams:
     """Reconstruct lane i's solo ``SimParams`` — the oracle a fleet lane
     must match bit for bit (tests/test_sim_fleet.py)."""
     return p_static.with_(**sweep.lane(i))
+
+
+def gather_lanes(sweep: SweepParams, idx: Sequence[int]) -> SweepParams:
+    """The sub-batch of ``sweep`` at lane indices ``idx`` (repeats
+    allowed — the compacted fleet pads survivor batches to the bucket
+    width by repeating a live lane).
+
+    Sweep knobs are per-lane vectors and the chaos stack is lane-major,
+    so a gather along the scenario axis IS re-batching: each surviving
+    lane keeps its own seed, knobs and full-horizon fault planes, and
+    the statics (``p_static``) are untouched — the re-batched fleet
+    traces the same program at a smaller width (fleet/run.py)."""
+    ii = np.asarray(list(idx), dtype=np.int64)
+    planes = None
+    if sweep.chaos_planes is not None:
+        planes = {k: np.asarray(v)[ii] for k, v in sweep.chaos_planes.items()}
+    hashes = None
+    if sweep.schedule_hashes is not None:
+        hashes = [sweep.schedule_hashes[int(i)] for i in ii]
+    return SweepParams(
+        seed=np.asarray(sweep.seed)[ii],
+        fanout=np.asarray(sweep.fanout)[ii],
+        max_transmissions=np.asarray(sweep.max_transmissions)[ii],
+        sync_interval=np.asarray(sweep.sync_interval)[ii],
+        write_rounds=np.asarray(sweep.write_rounds)[ii],
+        chaos_planes=planes,
+        schedule_hashes=hashes,
+    )

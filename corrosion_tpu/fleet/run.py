@@ -46,7 +46,45 @@ from ..sim import profile as profilemod
 from ..sim.model import TELEMETRY_FIELDS, SimParams
 from .batch import SweepParams
 
-__all__ = ["FleetResult", "run_fleet", "publish_metrics", "write_artifact"]
+__all__ = [
+    "CompactionStats",
+    "FleetResult",
+    "lane_record",
+    "lanes_mesh",
+    "run_fleet",
+    "publish_metrics",
+    "write_artifact",
+]
+
+
+@dataclass
+class CompactionStats:
+    """The shrink schedule one compacted fleet actually executed.
+
+    ``segments`` is the host-side bucket schedule: one entry per scan
+    segment with its absolute start round, scanned length, bucket width
+    (the power-of-two batch the executable was compiled for) and the
+    live lane count riding it (the rest is padding).
+    ``flop_rounds_saved`` is ``B·R − Σ width·seg_len`` — lane-rounds the
+    legacy full-batch scan would have burned but compaction did not
+    (early exit once every lane converges counts toward it)."""
+
+    interval: int
+    horizon: int  # the absolute scan bound R the schedule ran against
+    segments: List[Dict[str, int]]  # r_start / seg_len / width / active
+    lanes_compacted: int  # lanes dropped at a boundary before the horizon
+    flop_rounds_saved: int
+    devices: int = 1  # mesh size when lanes were sharded ('lanes' axis)
+
+    @property
+    def bucket_widths(self) -> List[int]:
+        """Distinct widths in schedule order (one executable each per
+        distinct (width, seg_len) signature)."""
+        seen: List[int] = []
+        for s in self.segments:
+            if s["width"] not in seen:
+                seen.append(s["width"])
+        return seen
 
 
 @dataclass
@@ -67,6 +105,7 @@ class FleetResult:
     schedule_hashes: Optional[List[str]] = None
     aot: Optional[str] = None  # "compile" | "disk" | "memory" (sim/aot.py)
     aot_bytes: int = 0  # serialized artifact size on disk
+    compaction: Optional[CompactionStats] = None  # None on the legacy path
 
     @property
     def n_scenarios(self) -> int:
@@ -107,12 +146,77 @@ def build_fleet_fn(p_static: SimParams, R: int, with_chaos: bool):
     return jax.jit(jax.vmap(lambda s, kv: lane(s, kv)), donate_argnums=0)
 
 
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << (int(n) - 1).bit_length()
+
+
+def lanes_mesh(n_devices: Optional[int] = None):
+    """A 1-D ``Mesh`` over the 'lanes' axis covering ``n_devices``
+    (default: every local device).  Lanes are embarrassingly parallel,
+    so ``shard_map`` over this axis splits a fleet batch across chips
+    with no cross-device collectives at all — each shard runs its own
+    vmapped lane block and results concatenate bit-identically.  On CPU
+    the `__graft_entry__.dryrun_multichip` virtual-device idiom
+    (``--xla_force_host_platform_device_count``) provides the devices."""
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"lanes mesh over {n_devices} devices but only "
+                f"{len(devs)} visible (set "
+                "--xla_force_host_platform_device_count before the "
+                "first backend init for CPU virtual devices)"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), ("lanes",))
+
+
+def build_fleet_seg_fn(
+    p_static: SimParams, seg_len: int, with_chaos: bool, mesh=None
+):
+    """One compacted-fleet segment executable, as a buildable.
+
+    Identical lane body to :func:`build_fleet_fn` but scanned for
+    ``seg_len`` rounds: the round counter rides the carry and every RNG
+    draw keys on it absolutely, so chaining segment scans is
+    bit-identical to one long scan.  With ``mesh`` the vmapped batch is
+    wrapped in ``shard_map`` over the 'lanes' axis (bucket width must be
+    a multiple of the mesh size); every operand and output is
+    lane-major, so the only sharding spec is ``P('lanes')`` on the
+    leading axis and no collective is emitted."""
+    lane = build_lane(p_static, seg_len)
+    if with_chaos:
+        fn = jax.vmap(lambda s, kv, ch: lane(s, kv, ch))
+    else:
+        fn = jax.vmap(lambda s, kv: lane(s, kv))
+    if mesh is not None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec
+
+        spec = PartitionSpec("lanes")
+        n_args = 3 if with_chaos else 2
+        fn = shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(spec,) * n_args,
+            out_specs=spec,
+            check_rep=False,
+        )
+    return jax.jit(fn, donate_argnums=0)
+
+
 def run_fleet(
     p_static: SimParams,
     sweep: SweepParams,
     return_state: bool = False,
     n_rounds: Optional[int] = None,
     aot=None,
+    compact: bool = False,
+    compaction_interval: int = 16,
+    mesh=None,
 ) -> FleetResult:
     """Execute one fleet batch (one compile, B lanes).
 
@@ -132,13 +236,36 @@ def run_fleet(
     statics (the tuner's rungs) reuse the in-memory executable, and a
     primed ``CORRO_AOT_DIR`` skips the cold compile entirely.  The
     batched round-0 carry is built host-side and **donated**, removing
-    a full B-lane state copy from peak HBM."""
+    a full B-lane state copy from peak HBM.
+
+    ``compact=True`` runs the v2 engine instead: the horizon is cut
+    into ``compaction_interval``-round scan segments, converged lanes
+    are dropped at every segment boundary (host-side — the batch is
+    re-gathered at shrinking power-of-two bucket widths), and the loop
+    exits as soon as every lane has converged.  A handful of AOT-cached
+    segment executables — one per (bucket width, segment length) — serve
+    the whole shrink schedule, and every lane stays bit-identical to
+    the legacy path and to solo ``cluster.run()``: the round counter
+    and all RNG keying ride the carry, and chaos planes are window-
+    sliced with a ``round_offset`` rebase (chaos.lower.slice_planes).
+    ``mesh`` (see :func:`lanes_mesh`) additionally shards each bucket
+    across devices over the 'lanes' axis."""
     from ..sim import aot as aotmod
 
     cache = aotmod.default_cache() if aot is None else aot
     B = sweep.n_scenarios
     R = p_static.max_rounds if n_rounds is None else n_rounds
     has_chaos = sweep.chaos_planes is not None
+    if compact or mesh is not None:
+        return _run_fleet_compacted(
+            p_static,
+            sweep,
+            R,
+            cache,
+            interval=compaction_interval if compact else R,
+            mesh=mesh,
+            return_state=return_state,
+        )
 
     kvs = (
         jnp.asarray(sweep.seed),
@@ -185,7 +312,41 @@ def run_fleet(
     telemetry = np.stack(
         [np.asarray(tel[f]) for f in TELEMETRY_FIELDS], axis=-1
     ).astype(np.int32)
+    return _finalize(
+        p_static,
+        sweep,
+        rounds=rounds,
+        converged=converged,
+        telemetry=telemetry,
+        wall_s=t2 - t1,
+        compile_s=t1 - t0,
+        state=tuple(out) if return_state else None,
+        aot=info.source,
+        aot_bytes=info.artifact_bytes,
+        compaction=None,
+    )
 
+
+def _finalize(
+    p_static: SimParams,
+    sweep: SweepParams,
+    rounds: np.ndarray,
+    converged: np.ndarray,
+    telemetry: np.ndarray,
+    wall_s: float,
+    compile_s: float,
+    state: Optional[tuple],
+    aot: Optional[str],
+    aot_bytes: int,
+    compaction: Optional[CompactionStats],
+) -> FleetResult:
+    """Per-lane outcome extraction shared by the legacy and compacted
+    paths — both hand over the SAME [B, R, 15] telemetry block (the
+    compacted path splices its segments back into it), so stall labels,
+    curves and the byte model are computed identically."""
+    B = int(rounds.shape[0])
+    total = p_static.n_nodes * p_static.n_changes
+    cp = telemetry[:, :, TELEMETRY_FIELDS.index("complete_pairs")]
     stalled: List[Optional[int]] = []
     curves: List[List[object]] = []
     bytes_conv = np.zeros(B, dtype=np.int64)
@@ -219,13 +380,299 @@ def run_fleet(
         telemetry=telemetry,
         bytes_to_convergence=bytes_conv,
         curves=curves,
-        wall_s=t2 - t1,
-        compile_s=t1 - t0,
-        state=tuple(out) if return_state else None,
+        wall_s=wall_s,
+        compile_s=compile_s,
+        state=state,
         schedule_hashes=sweep.schedule_hashes,
-        aot=info.source,
-        aot_bytes=info.artifact_bytes,
+        aot=aot,
+        aot_bytes=aot_bytes,
+        compaction=compaction,
     )
+
+
+def _run_fleet_compacted(
+    p_static: SimParams,
+    sweep: SweepParams,
+    R: int,
+    cache,
+    interval: int,
+    mesh,
+    return_state: bool,
+) -> FleetResult:
+    """The v2 engine: segment scans + converged-lane compaction.
+
+    Host loop at scan-segment boundaries only — inside a segment the
+    device program is the same done-gated vmapped lane as the legacy
+    path.  Per boundary: fetch the segment telemetry, mark lanes whose
+    ``complete_pairs`` hit the ceiling, splice their rows into the
+    global [B, R, 15] block, and re-gather the survivors (device-side
+    ``jnp.take`` on the state carry, host-side on knobs/planes) into
+    the next power-of-two bucket, padding short buckets by repeating a
+    live lane (lanes are independent, so padding rows are computed and
+    discarded without perturbing anything).  The executable key is
+    (statics, bucket width, segment length) — the absolute start round
+    is a traced operand (``round_offset``), so every segment of a given
+    shape reuses one executable."""
+    from ..chaos.lower import slice_planes
+    from ..sim import aot as aotmod
+
+    if interval < 1:
+        raise ValueError(f"compaction_interval must be >= 1; got {interval}")
+    B = sweep.n_scenarios
+    has_chaos = sweep.chaos_planes is not None
+    total = p_static.n_nodes * p_static.n_changes
+    D = 1 if mesh is None else int(mesh.devices.size)
+    if D & (D - 1):
+        raise ValueError(
+            f"lanes mesh size must be a power of two (bucket widths "
+            f"are); got {D} devices"
+        )
+    n_tel = len(TELEMETRY_FIELDS)
+    cp_col = TELEMETRY_FIELDS.index("complete_pairs")
+
+    kvs_np = (
+        np.asarray(sweep.seed),
+        np.asarray(sweep.fanout),
+        np.asarray(sweep.max_transmissions),
+        np.asarray(sweep.sync_interval),
+        np.asarray(sweep.write_rounds),
+    )
+    planes_np = (
+        None
+        if not has_chaos
+        else {k: np.asarray(v) for k, v in sweep.chaos_planes.items()}
+    )
+
+    telemetry = np.zeros((B, R, n_tel), dtype=np.int32)
+    rounds = np.full(B, R, dtype=np.int32)
+    converged = np.zeros(B, dtype=bool)
+    final_rows: List[Optional[tuple]] = [None] * B
+
+    active = np.arange(B)  # original lane ids still scanning
+    state = None  # device carry rows aligned with the current bucket
+    segments: List[Dict[str, int]] = []
+    n_compacted = 0
+    compile_s = 0.0
+    wall_s = 0.0
+    sources: List[str] = []
+    aot_bytes = 0
+    r_start = 0
+    while active.size and r_start < R:
+        seg_len = min(interval, R - r_start)
+        width = max(_pow2(active.size), D)
+        pad = width - active.size
+        take = (
+            np.concatenate([active, np.repeat(active[:1], pad)])
+            if pad
+            else active
+        )
+        if state is None:
+            state_b = cluster.init_state(p_static, batch=width)
+        else:
+            state_b = state
+        kvs_b = tuple(jnp.asarray(v[take]) for v in kvs_np)
+        if mesh is not None:
+            # the re-gathered carry comes back REPLICATED (jnp.take on
+            # the previous segment's shard_map outputs), but the segment
+            # executable was compiled for lane-sharded operands — place
+            # every leading axis on the 'lanes' axis explicitly or the
+            # compiled call rejects the sharding mismatch
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            lanes_sh = NamedSharding(mesh, PartitionSpec("lanes"))
+            state_b = tuple(jax.device_put(x, lanes_sh) for x in state_b)
+            kvs_b = tuple(jax.device_put(x, lanes_sh) for x in kvs_b)
+        args: tuple
+        if has_chaos:
+            pl = {k: v[take] for k, v in planes_np.items()}
+            pl = slice_planes(pl, r_start, seg_len)
+            planes_b = {k: jnp.asarray(v) for k, v in pl.items()}
+            if mesh is not None:
+                planes_b = {
+                    k: jax.device_put(v, lanes_sh)
+                    for k, v in planes_b.items()
+                }
+            args = (state_b, kvs_b, planes_b)
+        else:
+            args = (state_b, kvs_b)
+
+        def build():
+            return build_fleet_seg_fn(
+                p_static, seg_len, with_chaos=has_chaos, mesh=mesh
+            )
+
+        statics = (
+            aotmod.params_key(p_static),
+            ("fleet_seg", width, seg_len),
+            ("lanes_mesh", D),
+        )
+        t0 = time.perf_counter()
+        compiled, info = cache.get_or_compile(
+            "fleet.run_seg", statics, build, args, persist=mesh is None
+        )
+        t1 = time.perf_counter()
+        out, tel = jax.block_until_ready(compiled(*args))
+        t2 = time.perf_counter()
+        compile_s += t1 - t0
+        wall_s += t2 - t1
+        sources.append(info.source)
+        aot_bytes = max(aot_bytes, info.artifact_bytes)
+        segments.append(
+            {
+                "r_start": int(r_start),
+                "seg_len": int(seg_len),
+                "width": int(width),
+                "active": int(active.size),
+            }
+        )
+
+        n_act = active.size
+        tel_rows = np.stack(
+            [np.asarray(tel[f])[:n_act] for f in TELEMETRY_FIELDS], axis=-1
+        ).astype(np.int32)
+        telemetry[active, r_start : r_start + seg_len, :] = tel_rows
+        hit = tel_rows[:, :, cp_col] == total
+        conv_here = hit.any(axis=1)
+        first = hit.argmax(axis=1) + 1  # 1-based within the segment
+        for j in np.nonzero(conv_here)[0]:
+            lane = int(active[j])
+            converged[lane] = True
+            rounds[lane] = r_start + int(first[j])
+        if return_state:
+            done_local = (
+                np.nonzero(conv_here)[0]
+                if r_start + seg_len < R
+                else np.arange(n_act)
+            )
+            if done_local.size:
+                ii = jnp.asarray(done_local, dtype=jnp.int32)
+                cols = tuple(
+                    np.asarray(jnp.take(x, ii, axis=0)) for x in out
+                )
+                for slot, j in enumerate(done_local):
+                    final_rows[int(active[j])] = tuple(
+                        c[slot] for c in cols
+                    )
+        surv_local = np.nonzero(~conv_here)[0]
+        dropped = n_act - surv_local.size
+        active = active[surv_local]
+        r_start += seg_len
+        if dropped and r_start < R:
+            # these lanes stop costing FLOPs while the legacy path
+            # would have scanned them to the horizon
+            n_compacted += dropped
+        if active.size and r_start < R:
+            next_width = max(_pow2(active.size), D)
+            next_pad = next_width - surv_local.size
+            take_local = (
+                np.concatenate(
+                    [surv_local, np.repeat(surv_local[:1], next_pad)]
+                )
+                if next_pad
+                else surv_local
+            )
+            ii = jnp.asarray(take_local, dtype=jnp.int32)
+            state = tuple(jnp.take(x, ii, axis=0) for x in out)
+
+    scanned_cost = sum(s["width"] * s["seg_len"] for s in segments)
+    stats = CompactionStats(
+        interval=int(interval),
+        horizon=int(R),
+        segments=segments,
+        lanes_compacted=int(n_compacted),
+        flop_rounds_saved=int(B * R - scanned_cost),
+        devices=D,
+    )
+    state_out = None
+    if return_state:
+        assert all(rowv is not None for rowv in final_rows)
+        state_out = tuple(
+            np.stack([final_rows[b][c] for b in range(B)])
+            for c in range(len(final_rows[0]))
+        )
+    if "compile" in sources:
+        source = "compile"
+    elif "disk" in sources:
+        source = "disk"
+    else:
+        source = "memory" if sources else "compile"
+    return _finalize(
+        p_static,
+        sweep,
+        rounds=rounds,
+        converged=converged,
+        telemetry=telemetry,
+        wall_s=wall_s,
+        compile_s=compile_s,
+        state=state_out,
+        aot=source,
+        aot_bytes=aot_bytes,
+        compaction=stats,
+    )
+
+
+def _segment_record(
+    res: FleetResult, b: int, r_start: int, r_end: int
+) -> flightmod.FlightRecord:
+    """Lane ``b``'s flight segment over scanned rounds
+    ``[r_start, r_end)``, cut from the assembled telemetry block —
+    byte-compatible with ``sim.flight.record_run`` on the same span."""
+    p = res.p_static
+    horizon = (
+        res.compaction.horizon
+        if res.compaction is not None
+        else res.telemetry.shape[1]
+    )
+    conv = bool(res.converged[b]) and int(res.rounds[b]) <= r_end
+    rounds = int(res.rounds[b]) if conv else r_end
+    rows = res.telemetry[b, r_start:rounds, :]
+    series = {
+        f: [int(v) for v in rows[:, i]]
+        for i, f in enumerate(TELEMETRY_FIELDS)
+    }
+    return flightmod.FlightRecord(
+        n_nodes=p.n_nodes,
+        n_changes=p.n_changes,
+        nseq_max=p.nseq_max,
+        seed=int(res.sweep.seed[b]),
+        packed=p.packed,
+        max_rounds=horizon,
+        rounds=rounds,
+        converged=conv,
+        schedule_hash=(
+            res.schedule_hashes[b]
+            if res.schedule_hashes is not None
+            else None
+        ),
+        start_round=r_start,
+        series=series,
+    )
+
+
+def lane_record(res: FleetResult, b: int) -> flightmod.FlightRecord:
+    """Lane ``b``'s full flight record, spliced across the compaction
+    segments it rode with ``sim.flight.concat_records`` — the same
+    splicing contract checkpoint/resume uses, so the result is
+    bit-identical to solo ``cluster.run(record=True)`` with the lane's
+    params (tests/test_sim_fleet.py asserts NDJSON byte equality).  On
+    a legacy (non-compacted) result the whole span is one segment."""
+    if res.compaction is None:
+        horizon = res.telemetry.shape[1]
+        return _segment_record(res, b, 0, horizon)
+    rec: Optional[flightmod.FlightRecord] = None
+    lane_rounds = int(res.rounds[b])
+    for seg in res.compaction.segments:
+        r_start = seg["r_start"]
+        if r_start >= lane_rounds:
+            break  # lane was compacted out before this segment
+        seg_rec = _segment_record(
+            res, b, r_start, r_start + seg["seg_len"]
+        )
+        rec = (
+            seg_rec if rec is None else flightmod.concat_records(rec, seg_rec)
+        )
+    assert rec is not None
+    return rec
 
 
 def publish_metrics(res: FleetResult) -> None:
@@ -246,6 +693,20 @@ def publish_metrics(res: FleetResult) -> None:
         registry.gauge(
             "corro.sim.fleet.bytes_to_convergence", nodes=nodes
         ).set(float(conv_bytes.min()))
+    if res.compaction is not None:
+        st = res.compaction
+        registry.gauge(
+            "corro.sim.fleet.compaction.segments", nodes=nodes
+        ).set(float(len(st.segments)))
+        registry.gauge(
+            "corro.sim.fleet.compaction.lanes_compacted", nodes=nodes
+        ).set(float(st.lanes_compacted))
+        registry.gauge(
+            "corro.sim.fleet.compaction.flop_rounds_saved", nodes=nodes
+        ).set(float(st.flop_rounds_saved))
+        registry.gauge(
+            "corro.sim.fleet.compaction.bucket_widths", nodes=nodes
+        ).set(float(len(st.bucket_widths)))
 
 
 def _lane_doc(res: FleetResult, b: int) -> Dict[str, object]:
@@ -325,7 +786,7 @@ def fleet_markdown(lines: List[dict]) -> str:
         "|---|---|---|---|---|---|---|---|",
     ]
     for ln in lines:
-        if not ln.get("fleet"):
+        if not ln.get("fleet") or ln.get("fleet_v2"):
             continue
         rmin, rmax = ln.get("rounds_min"), ln.get("rounds_max")
         rounds = f"{rmin}–{rmax}" if rmin != rmax else str(rmin)
@@ -345,6 +806,102 @@ def fleet_markdown(lines: List[dict]) -> str:
                 sp=speed,
             )
         )
+    v2 = [ln for ln in lines if ln.get("fleet_v2")]
+    if v2:
+        out += [
+            "",
+            "### Fleet v2: converged-lane compaction",
+            "",
+            "The v2 engine cuts the horizon into compaction-interval",
+            "segments and drops converged lanes at every boundary,",
+            "re-batching survivors at shrinking power-of-two bucket",
+            "widths (one AOT executable per bucket shape) — so the warm",
+            "fleet stops paying full-batch FLOPs for finished lanes.",
+            "``vs legacy`` compares the warm compacted wall against the",
+            "warm v1 fleet on the same sweep; ``warm solo-sum`` is one",
+            "measured WARM solo execute × B.  Every lane stays",
+            "bit-identical to solo ``cluster.run()``.",
+            "",
+            "| metric | lanes | interval | segments | buckets "
+            "| FLOP-rounds saved | warm wall | legacy warm | vs legacy "
+            "| warm solo-sum |",
+            "|---|---|---|---|---|---|---|---|---|---|",
+        ]
+        for ln in v2:
+            warm = ln.get("value", 0.0)
+            ss = ln.get("warm_solo_sum_est_s", 0.0)
+            legacy = ln.get("legacy_warm_wall_s", 0.0)
+            vs_legacy = legacy / warm if warm else 0.0
+            buckets = "→".join(
+                str(w) for w in ln.get("bucket_widths", [])
+            )
+            out.append(
+                "| {m} | {b} | {iv} | {sg} | {bk} | {fs:,} "
+                "| {w:.2f} s | {lg:.2f} s | **{vl:.1f}×** "
+                "| {ss:.2f} s |".format(
+                    m=str(ln.get("metric", "?"))
+                    .replace("sim_", "")
+                    .replace("_wall", ""),
+                    b=ln.get("n_scenarios", "?"),
+                    iv=ln.get("compaction_interval", "?"),
+                    sg=ln.get("segments", "?"),
+                    bk=buckets or "?",
+                    fs=ln.get("flop_rounds_saved", 0),
+                    w=warm,
+                    lg=legacy,
+                    vl=vs_legacy,
+                    ss=ss,
+                )
+            )
+        out += [
+            "",
+            "On CPU the warm solo-sum estimate is not a reachable bar",
+            "for ANY batched engine: a warm solo round costs ~0.4 ms",
+            "(every knob and the seed bake into the program as",
+            "constants, so XLA folds the untaken fanout/sync slots",
+            "away) while a fleet lane-round costs ~5 ms even at batch",
+            "width 1, because the traced knob ceilings keep every slot",
+            "live and the select-gated sync phase runs every round.",
+            "Compaction removes the *schedule* waste (the FLOP-rounds",
+            "column); the remaining gap is per-lane-round program",
+            "cost, which batching targets on accelerators, not CPU.",
+        ]
+    tuner = [ln for ln in lines if ln.get("tuner")]
+    if tuner:
+        out += [
+            "",
+            "### Closed-loop tuner: fit the regime, then search it",
+            "",
+            "``fleet tune --telemetry`` fits observed flight/loadgen",
+            "telemetry (write scale, loss, convergence horizon) and",
+            "re-runs successive halving against the fitted regime at",
+            "the fitted horizon with compaction on, instead of the",
+            "configured worst-case ``max_rounds``.  Cold walls below",
+            "include XLA compiles on both sides; the warm ratio",
+            "(telemetry-primed shared AOT cache) is >5× — see",
+            "``tests/test_sim_fleet.py`` (slow marker).",
+            "",
+            "| metric | open loop | closed loop | ratio | fitted horizon "
+            "| recommended (fo, mt, si) |",
+            "|---|---|---|---|---|---|",
+        ]
+        for ln in tuner:
+            rec = ln.get("closed_recommended") or []
+            out.append(
+                "| {m} | {o:.2f} s | {c:.2f} s | **{r:.2f}×** | {h} "
+                "| {rec} |".format(
+                    m=str(ln.get("metric", "?")).replace("_wall", ""),
+                    o=ln.get("open_loop_s", 0.0),
+                    c=ln.get("closed_loop_s", 0.0),
+                    r=(
+                        ln.get("open_loop_s", 0.0) / ln["value"]
+                        if ln.get("value")
+                        else 0.0
+                    ),
+                    h=ln.get("fit_horizon", "?"),
+                    rec=", ".join(str(v) for v in rec) or "?",
+                )
+            )
     out += ["", END_MARK]
     return "\n".join(out)
 

@@ -130,6 +130,16 @@ class DevCluster:
         self._uni_exp = 0
         self._uni_got = 0
         self._drain_timeouts = 0
+        # received-but-unprocessed datagrams (tracked mode): the event
+        # loop's socket-readiness order decides ARRIVAL order, and under
+        # machine load that order shifts between runs — SWIM's bounded
+        # piggyback (max_piggyback updates per message) makes outcomes
+        # order-sensitive, so a refute could ride a different message
+        # and land a round late.  Buffering here and processing at the
+        # ledger barrier in (receiver, sender) NAME order makes handling
+        # order a pure function of the schedule: [(recv_name, src_addr,
+        # data, node, handler)]
+        self._dgram_buf: list = []
         # -- partition injection ------------------------------------------
         # addr -> side; while active, cross-side traffic is dropped at the
         # SENDER (datagrams and uni frames silently, bi/sync connects with
@@ -215,7 +225,7 @@ class DevCluster:
             await node.agent.pool.write_call(
                 lambda c, s=self.schema: apply_schema(c, s)
             )
-        self._instrument(node)
+        self._instrument(node, name)
         self._install_partition_filter(node)
         return node
 
@@ -318,13 +328,16 @@ class DevCluster:
 
         tp.open_bi = open_bi
 
-    def _instrument(self, node) -> None:
+    def _instrument(self, node, name: str) -> None:
         """Wrap the node's transport send/receive callbacks with delivery
         accounting (see the ledger note in ``__init__``).  Sends to dead
         addresses are NOT expected — a crash-stopped node's traffic just
-        vanishes, exactly like the real network.  Receive counters are
-        bumped AFTER the handler ran, so got==exp means every in-flight
-        message has been fully HANDLED, not merely delivered."""
+        vanishes, exactly like the real network.  Datagram receives are
+        BUFFERED, not handled inline: got==exp then means every in-flight
+        datagram has been received, and the barrier replays the buffer in
+        deterministic order (``_process_dgram_buf``).  Uni-frame counters
+        are still bumped AFTER the handler ran, so their barrier means
+        fully HANDLED (received and submitted to ingestion)."""
         tp = node.transport
         if self._track_dgram:
             orig_send_dg = tp.send_datagram
@@ -347,7 +360,9 @@ class DevCluster:
             orig_on_dg = tp.on_datagram
 
             def on_dg(addr, data, _o=orig_on_dg):
-                _o(addr, data)
+                self._dgram_buf.append(
+                    (name, (addr[0], addr[1]), data, node, _o)
+                )
                 # clamp: after a timeout reconcile, a late straggler must
                 # not push got past exp and weaken later barriers
                 if self._dgram_got < self._dgram_exp:
@@ -378,6 +393,26 @@ class DevCluster:
 
             tp.on_uni_frame = on_uni
 
+    def _process_dgram_buf(self) -> None:
+        """Replay buffered datagrams in (receiver, sender) name order —
+        a STABLE sort, so per-(sender → receiver) arrival order (loopback
+        FIFO) survives and only the cross-sender interleaving, the part
+        the event loop scheduled, is canonicalized.  Names, not ports:
+        ports are ephemeral per boot and would order differently between
+        byte-identical runs.  Handling is sans-IO (swim core buffers its
+        responses for the next pump), so no sends happen mid-replay."""
+        if not self._dgram_buf:
+            return
+        buf, self._dgram_buf = self._dgram_buf, []
+        addr_name = {
+            ("127.0.0.1", port): nm for nm, port in self._ports.items()
+        }
+        buf.sort(key=lambda e: (e[0], addr_name.get(e[1], "~")))
+        for recv_name, addr, data, node, handler in buf:
+            if self.nodes.get(recv_name) is not node:
+                continue  # receiver crash-stopped before the barrier
+            handler(addr, data)
+
     async def drain_deliveries(self, timeout: float = 60.0) -> bool:
         """Count-based delivery barrier: flush every transport, then wait
         until every tracked message sent to a live node has been handled.
@@ -399,6 +434,7 @@ class DevCluster:
                 self._dgram_got >= self._dgram_exp
                 and self._uni_got >= self._uni_exp
             ):
+                self._process_dgram_buf()
                 return True
             if time.monotonic() > deadline:
                 # reconcile: a genuinely lost message (kernel-dropped
@@ -407,6 +443,7 @@ class DevCluster:
                 self._drain_timeouts += 1
                 self._dgram_got = self._dgram_exp
                 self._uni_got = self._uni_exp
+                self._process_dgram_buf()
                 return False
             await asyncio.sleep(0.002)
 

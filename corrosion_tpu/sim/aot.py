@@ -176,6 +176,12 @@ class AotCache:
         )
         self.hits = 0
         self.misses = 0
+        # entry name -> {"compile" | "disk" | "memory" -> count}: how
+        # every get_or_compile call was served, per entry point.  The
+        # compacted fleet's one-executable-per-bucket-width contract is
+        # asserted against this (misses_for), and bench stamps it so a
+        # compile-count regression shows up in the artifact diff
+        self.stats: Dict[str, Dict[str, int]] = {}
 
     # -- keys ---------------------------------------------------------------
 
@@ -220,6 +226,7 @@ class AotCache:
         if hit is not None:
             self._mem.move_to_end(key)
             self.hits += 1
+            self._count(entry, "memory")
             return hit[0], AotEntry("memory", key, hit[1], hit[2])
         path = self.path_for(entry, key) if persist else None
         if path and os.path.exists(path):
@@ -228,6 +235,7 @@ class AotCache:
                 size = os.path.getsize(path)
                 self._remember(key, fn, path, size)
                 self.hits += 1
+                self._count(entry, "disk")
                 return fn, AotEntry("disk", key, path, size)
         if path:
             compiled = self._compile_uncached(build, args)
@@ -236,7 +244,17 @@ class AotCache:
         size = self._dump(compiled, path, key) if path else 0
         self._remember(key, compiled, path, size)
         self.misses += 1
+        self._count(entry, "compile")
         return compiled, AotEntry("compile", key, path, size)
+
+    def _count(self, entry: str, source: str) -> None:
+        by = self.stats.setdefault(entry, {})
+        by[source] = by.get(source, 0) + 1
+
+    def misses_for(self, entry: str) -> int:
+        """Fresh compiles this cache performed for ``entry`` (disk and
+        memory hits excluded)."""
+        return self.stats.get(entry, {}).get("compile", 0)
 
     def clear_memory(self) -> None:
         self._mem.clear()

@@ -164,6 +164,11 @@ class _StepEnv:
     c_pact: Optional[jnp.ndarray]
     c_drop: Optional[jnp.ndarray]
     c_seed: object
+    # plane rebase for window-sliced stacks (chaos.lower.slice_planes):
+    # round-major gathers use r - c_off while the RNG keeps the absolute
+    # round from the carry; None = planes cover rounds from 0 (solo path
+    # and full-horizon fleets compile the exact pre-offset program)
+    c_off: object = None
 
     @staticmethod
     def build(p: SimParams, chaos, chaos_arrays, knobs) -> "_StepEnv":
@@ -171,6 +176,7 @@ class _StepEnv:
         fleet = knobs is not None
         part = c_dead = c_die = c_restart = c_pact = c_drop = None
         c_seed = 0
+        c_off = None
         has_chaos = has_die = False
         if chaos is not None:
             assert chaos_arrays is None, (
@@ -208,6 +214,7 @@ class _StepEnv:
             c_pact = chaos_arrays["part_active"]
             c_drop = chaos_arrays.get("drop_ppm")
             c_seed = chaos_arrays["seed"]
+            c_off = chaos_arrays.get("round_offset")
         return _StepEnv(
             fleet=fleet,
             kn=kn,
@@ -220,6 +227,7 @@ class _StepEnv:
             c_pact=c_pact,
             c_drop=c_drop,
             c_seed=c_seed,
+            c_off=c_off,
         )
 
 
@@ -372,7 +380,12 @@ def make_step(
     ``chaos_arrays`` is the fleet twin of ``chaos``: an already-stacked
     plane dict from :meth:`corrosion_tpu.chaos.LoweredChaos.stack`,
     sliced (or vmapped) to one lane — same per-round gathers, without a
-    host ``LoweredChaos`` object per trace."""
+    host ``LoweredChaos`` object per trace.  An optional
+    ``round_offset`` entry (``chaos.lower.slice_planes``) marks planes
+    windowed to rounds ``[offset, offset + len)``: gathers rebase to
+    ``r - offset`` while the RNG keeps the carry's absolute round, so a
+    compacted fleet segment (fleet/run.py) stays bit-identical to the
+    full-horizon program."""
     N, K, S = p.n_nodes, p.n_changes, max(1, p.nseq_max)
     D = p.churn_down_rounds
     env = _StepEnv.build(p, chaos, chaos_arrays, knobs)
@@ -386,6 +399,7 @@ def make_step(
     c_pact = env.c_pact
     c_drop = env.c_drop
     c_seed = env.c_seed
+    c_off = env.c_off
     seed = kn.seed
     origin, inject_round, part = _consts(p, seed, kn.write_rounds)
     if has_chaos:
@@ -571,12 +585,16 @@ def make_step(
 
     def step(state: SimState) -> SimState:
         cov, budget, status, since, r = state
+        # window-sliced plane stacks gather at the rebased row; every
+        # RNG draw below stays keyed on the absolute round r, so a
+        # sliced segment and the full-horizon program draw identically
+        cr = r if c_off is None else r - c_off
         if has_chaos:
             # liveness / restart / partition gathers into the lowered
             # schedule tensors (constants folded into the executable)
-            alive = jnp.logical_not(c_dead[r])
-            restarted = c_restart[r]
-            part_active = c_pact[r]
+            alive = jnp.logical_not(c_dead[cr])
+            restarted = c_restart[cr]
+            part_active = c_pact[cr]
         else:
             alive = alive_at(r)
             restarted = jnp.logical_and(
@@ -587,7 +605,7 @@ def make_step(
         pvec = jnp.where(part_active, part, jnp.int8(0))
 
         if c_drop is not None:
-            dppm = c_drop[r]  # int32[N, N] drop probability this round
+            dppm = c_drop[cr]  # int32[N, N] drop probability this round
 
             def link_up(src, dst):
                 """bool: link src→dst carries traffic this round — one
@@ -1158,7 +1176,7 @@ def make_step(
         # an explicit chaos schedule
         die = None
         if has_die:
-            die = c_die[r]
+            die = c_die[cr]
         elif (not has_chaos) and p.churn_ppm > 0 and p.churn_rounds > 0:
             die = death(r)
         # graftlint: disable=GL101 (identity check on whether a wipe plane exists this trace — decided at trace time, not a tracer comparison)

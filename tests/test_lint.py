@@ -104,6 +104,40 @@ jax.jit(build)
     assert trace_rules(good) == set()
 
 
+def test_gl101_rebatch_boundary_branch_on_traced_mask():
+    # the fleet-v2 anti-pattern: branching the compaction decision on
+    # the traced convergence mask INSIDE the compiled segment — the
+    # predicate is a tracer, so the Python `if` burns at trace time
+    bad = """
+import jax
+def seg(carry):
+    done = carry[0].all()
+    if done:
+        return carry
+    return step(carry)
+jax.jit(seg)
+"""
+    assert "GL101" in trace_rules(bad)
+
+
+def test_gl101_rebatch_boundary_host_fetch_not_flagged():
+    # the blessed idiom (fleet/run.py _run_fleet_compacted): run the
+    # segment to completion, FETCH the mask with np.asarray (host
+    # sync), then branch/gather in plain Python between programs
+    good = """
+import jax
+import numpy as np
+def run_segments(carry, seg_fn):
+    carry = seg_fn(carry)
+    done = np.asarray(carry[0])
+    if done.all():
+        return carry
+    keep = np.flatnonzero(~done)
+    return tuple(np.asarray(x)[keep] for x in carry)
+"""
+    assert trace_rules(good) == set()
+
+
 # -- GL102: impure calls in pure regions -------------------------------------
 
 def test_gl102_time_and_nprandom():

@@ -447,6 +447,61 @@ def run_fleet_bench(seed: int, scale: float, dev, cache_dir: str,
     return [legacy_line, v2_line, tuner_line]
 
 
+def run_phase_profile_bench(seed: int, dev) -> dict:
+    """Phase-attribution leg (corrosion_tpu/obs): profile the warm solo
+    step, one fleet lane at batch width 1, and the CRDT merge on the
+    config-3 100-node regime; publish the ``corro.sim.phase.*`` gauges,
+    regenerate the BENCHMARKS.md "Phase attribution" section, and stamp
+    the per-phase decomposition of the fleet-vs-solo lane-round gap
+    (ROADMAP item 4) into the JSON line."""
+    import os
+
+    from corrosion_tpu.obs import attr
+    from corrosion_tpu.sim import model
+
+    p = model.CONFIGS[3](seed=seed).with_(n_nodes=100)
+    solo = attr.profile_solo_step(p)
+    fleet = attr.profile_fleet_lane(p, B=1)
+    crdtp = attr.profile_crdt_merge(p)
+    profiles = [solo, fleet, crdtp]
+    attr.publish_metrics(profiles)
+    diff = attr.diff_profiles(solo, fleet)
+    log(
+        f"phase profile: solo {solo.wall_ms:.3f} ms/round vs fleet lane "
+        f"{fleet.wall_ms:.3f} ms/round "
+        f"({diff.get('gap_ratio') or 0:.1f}x)"
+    )
+    body = (
+        attr.profiles_markdown(profiles)
+        + "\n\n### Fleet-vs-solo lane-round decomposition (ROADMAP item 4)"
+        + "\n\n"
+        + attr.diff_markdown(diff)
+    )
+    md_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCHMARKS.md"
+    )
+    attr.update_benchmarks(
+        md_path, body, title=f"config-3 @ {p.n_nodes}n, {dev.platform}"
+    )
+    log(f"regenerated phase-attribution section of {md_path}")
+    return {
+        "metric": f"phase_attribution_{p.n_nodes}n_config3",
+        "value": round(fleet.wall_ms, 4),
+        "unit": "ms",
+        "phase_profile": True,
+        "solo_round_ms": round(solo.wall_ms, 4),
+        "fleet_round_ms": round(fleet.wall_ms, 4),
+        "gap_ratio": (
+            round(diff["gap_ratio"], 2)
+            if diff.get("gap_ratio") is not None
+            else None
+        ),
+        "profiles": [prof.to_dict() for prof in profiles],
+        "diff": diff,
+        "device": dev.platform,
+    }
+
+
 def run_mesh_dryrun_bench() -> dict:
     """The mesh dryrun BENCH leg: execute the full simulation step on the
     8-device virtual 2-D mesh, then run the GL5xx/GL6xx semantic tier and
@@ -561,6 +616,30 @@ def main() -> None:
         "(corrosion_tpu/pubsub/vmatch; pass no values to skip)",
     )
     ap.add_argument(
+        "--phase-profile",
+        action="store_true",
+        help="append the phase-attribution leg (corrosion_tpu/obs): "
+        "per-phase device cost for the solo step, a B=1 fleet lane, and "
+        "the CRDT merge, plus the fleet-vs-solo lane-round "
+        "decomposition; regenerates the BENCHMARKS.md marker-delimited "
+        "'Phase attribution' section",
+    )
+    ap.add_argument(
+        "--check-regression",
+        action="store_true",
+        help="after the run, compare every emitted line against the "
+        "committed BENCH_r*.json trajectory (corrosion_tpu/obs/regress) "
+        "and exit non-zero on regressions; the verdict is appended as a "
+        "final JSON line",
+    )
+    ap.add_argument(
+        "--lines",
+        default=None,
+        metavar="NDJSON",
+        help="with --check-regression: gate an existing bench-output "
+        "NDJSON file instead of running anything (no device, no jax)",
+    )
+    ap.add_argument(
         "--mesh-dryrun",
         action="store_true",
         help="run the 8-device 2-D-mesh dryrun leg instead: execute the "
@@ -571,9 +650,50 @@ def main() -> None:
     )
     args = ap.parse_args()
 
+    emitted: list = []
+
+    def emit(doc: dict) -> None:
+        emitted.append(doc)
+        print(json.dumps(doc), flush=True)
+
+    def finish() -> None:
+        """--check-regression epilogue: gate every emitted line against
+        the committed BENCH_r*.json trajectory, append the verdict as a
+        final JSON line, exit non-zero on regressions."""
+        if not (args.check_regression or args.lines):
+            return
+        import os
+
+        from corrosion_tpu.obs import regress
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        report = regress.check(emitted, repo)
+        log(regress.format_report(report))
+        print(json.dumps({"bench": "regression_gate", **report}), flush=True)
+        if not report["ok"]:
+            sys.exit(1)
+
+    if args.lines:
+        # cheap gate path: no device, no jax — read an existing bench
+        # NDJSON and compare it against the committed trajectory
+        with open(args.lines, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict) and "metric" in doc:
+                    emitted.append(doc)
+        finish()
+        return
+
     if args.mesh_dryrun:
         out = run_mesh_dryrun_bench()
-        print(json.dumps(out), flush=True)
+        emit(out)
+        finish()
         return
 
     if args.serve:
@@ -586,18 +706,19 @@ def main() -> None:
 
         t0 = time.perf_counter()
         out = run_serve_bench(args.seed, args.serve_qps)
-        print(json.dumps(out), flush=True)
+        emit(out)
         log(f"serve leg wall: {time.perf_counter()-t0:.2f}s")
         # vectorized-matcher throughput at 1k/10k/100k standing subs
         # (pubsub/vmatch; these legs DO use the device)
         for n_subs in args.matcher_subs:
             t0 = time.perf_counter()
             out = run_matcher_bench(n_subs, seed=args.seed)
-            print(json.dumps(out), flush=True)
+            emit(out)
             log(
                 f"matcher leg ({n_subs} subs) wall: "
                 f"{time.perf_counter()-t0:.2f}s"
             )
+        finish()
         return
 
     t_all = time.perf_counter()
@@ -638,11 +759,14 @@ def main() -> None:
             args.seed, args.scale, dev, cache_dir,
             packed=packed, framed=framed, aot=aot,
         ):
-            print(json.dumps(out), flush=True)
+            emit(out)
+        if args.phase_profile:
+            emit(run_phase_profile_bench(args.seed, dev))
         log(
             f"total harness wall (incl. imports): "
             f"{time.perf_counter()-t_all:.2f}s"
         )
+        finish()
         return
 
     # the full BASELINE config set; headline config 4 goes LAST so
@@ -670,7 +794,7 @@ def main() -> None:
                     4, args.seed, 10.0, dev, cache_dir,
                     packed=packed, framed=framed, aot=aot,
                 )
-                print(json.dumps(out), flush=True)
+                emit(out)
             else:
                 log(
                     f"1M headroom run skipped: need ~{1.5 * need / 1e9:.1f} GB "
@@ -681,8 +805,11 @@ def main() -> None:
             n, args.seed, args.scale, dev, cache_dir,
             packed=packed, framed=framed, aot=aot,
         )
-        print(json.dumps(out), flush=True)
+        emit(out)
+    if args.phase_profile:
+        emit(run_phase_profile_bench(args.seed, dev))
     log(f"total harness wall (incl. imports): {time.perf_counter()-t_all:.2f}s")
+    finish()
 
 
 if __name__ == "__main__":

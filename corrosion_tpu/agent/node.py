@@ -283,6 +283,10 @@ class Node:
             )
             await self.pg.start(pg_host, pg_port)
 
+        from ..utils import tracing as tracingmod
+
+        tracingmod.configure(self.config.telemetry.span_buffer)
+
         if (
             self.config.telemetry.otlp_endpoint
             or self.config.telemetry.otlp_file

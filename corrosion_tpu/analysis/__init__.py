@@ -29,10 +29,12 @@ from .suppress import apply_suppressions, scan_suppressions
 # Pass scopes, relative to the package root (corrosion_tpu/).  An entry
 # may be a nested "dir/subdir" to scope a pass to one device-program
 # package inside an otherwise-host-side dir (pubsub/vmatch is jitted
-# JAX; the rest of pubsub/ is asyncio + sqlite).
-TRACE_SAFETY_DIRS = ("sim", "crdt", "pubsub/vmatch")
+# JAX; the rest of pubsub/ is asyncio + sqlite).  obs/ qualifies on
+# both axes: annotate.py runs inside traced step code, and attr.py
+# jits the profiled entries itself.
+TRACE_SAFETY_DIRS = ("sim", "crdt", "pubsub/vmatch", "obs")
 ASYNC_DIRS = ("agent", "swim", "sync", "broadcast", "transport")
-DONATION_DIRS = ("sim", "crdt", "fleet", "pubsub/vmatch")
+DONATION_DIRS = ("sim", "crdt", "fleet", "pubsub/vmatch", "obs")
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

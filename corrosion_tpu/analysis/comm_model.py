@@ -23,6 +23,18 @@ for all-gather it is the gathered output, an upper bound on what any
 device receives.  The model only needs to be accurate enough to compare
 against the per-round gossip frame budget (sim/frames.py) at one order
 of magnitude.
+
+PR 19 extends the same parser to EVERY instruction
+(:func:`parse_hlo_ops`): each op carries an ``op_name`` path in its
+metadata (``op_name="jit(step)/sync/reduce"``) whose components include
+any ``jax.named_scope`` the op was traced under, so per-op cost
+estimates roll up by phase (obs/annotate.py vocabulary; obs/attr.py
+does the roll-up).  The per-op cost model is the same crude order:
+bytes = serialized result shape(s) — the write side of a memory-bound
+op — and flops = result element count for compute opcodes (zero for
+pure data movement).  Wrapper ops (``fusion``, ``call``, ``while``,
+``conditional``) are skipped: their cost is carried by the ops of the
+computations they call, which hold the real scope metadata.
 """
 
 from __future__ import annotations
@@ -70,6 +82,59 @@ _META_FILE_RE = re.compile(r'source_file="([^"]*)"')
 _META_LINE_RE = re.compile(r"source_line=(\d+)")
 _META_OP_RE = re.compile(r'op_name="([^"]*)"')
 
+# Any instruction: `  [ROOT] %name = <result shapes> opcode(...)`.  The
+# non-greedy result stops at the first `word(` — the opcode — which is
+# safe because shape text (`f32[4]{0}`, tuples of shapes) never contains
+# an identifier directly followed by `(`.
+_INSTR_RE = re.compile(
+    r"^\s+(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?P<result>.*?)\s+"
+    r"(?P<opcode>[a-z][a-z0-9\-]*)\("
+)
+
+# Structural / wrapper opcodes that carry no device cost of their own:
+# either free metadata ops, or call-like wrappers whose cost lives in
+# the ops of the computation they call (parsed separately).
+_NO_COST_OPCODES = frozenset(
+    {
+        "parameter",
+        "constant",
+        "get-tuple-element",
+        "tuple",
+        "bitcast",
+        "after-all",
+        "partition-id",
+        "replica-id",
+        "opt-barrier",
+        "fusion",
+        "call",
+        "while",
+        "conditional",
+    }
+)
+
+# Pure data movement: bytes count, flops do not.
+_MOVE_OPCODES = frozenset(
+    {
+        "copy",
+        "copy-start",
+        "broadcast",
+        "reshape",
+        "transpose",
+        "slice",
+        "dynamic-slice",
+        "dynamic-update-slice",
+        "concatenate",
+        "pad",
+        "reverse",
+        "iota",
+        "bitcast-convert",
+        "all-gather",
+        "all-to-all",
+        "collective-permute",
+        "collective-broadcast",
+    }
+)
+
 
 def shape_bytes(text: str) -> int:
     """Sum serialized bytes of every ``dtype[dims]`` shape in ``text``."""
@@ -84,6 +149,33 @@ def shape_bytes(text: str) -> int:
                 n *= int(d)
         total += n * size
     return total
+
+
+def shape_elems(text: str) -> int:
+    """Sum element counts of every ``dtype[dims]`` shape in ``text``."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n
+    return total
+
+
+def phase_of(op_name: str, phases: Sequence[str]) -> Optional[str]:
+    """First ``op_name`` path component that names a phase, else None.
+
+    FIRST, not innermost: scopes nest (a sync-phase peer draw traces as
+    ``…/sync/draw/…``), and the outermost phase is the pipeline stage
+    the cost belongs to.
+    """
+    for comp in op_name.split("/"):
+        if comp in phases:
+            return comp
+    return None
 
 
 @dataclass(frozen=True)
@@ -104,6 +196,30 @@ class Collective:
             "op_name": self.op_name,
             "source_file": self.source_file,
             "source_line": self.source_line,
+            "in_loop_body": self.in_loop_body,
+        }
+
+
+@dataclass(frozen=True)
+class OpCost:
+    """Crude per-instruction cost estimate with phase provenance."""
+
+    opcode: str
+    phase: Optional[str]  # obs/annotate.py phase, None = unattributed
+    flops: int  # result elements for compute opcodes, 0 for movement
+    bytes: int  # serialized result shape(s) — the op's write side
+    computation: str
+    op_name: str
+    in_loop_body: bool  # runs once per loop iteration (scan round)
+
+    def to_dict(self) -> dict:
+        return {
+            "opcode": self.opcode,
+            "phase": self.phase,
+            "flops": self.flops,
+            "bytes": self.bytes,
+            "computation": self.computation,
+            "op_name": self.op_name,
             "in_loop_body": self.in_loop_body,
         }
 
@@ -177,12 +293,11 @@ def _reachable(
     return seen
 
 
-def parse_hlo(hlo_text: str) -> HloModel:
-    comps = _split_computations(hlo_text)
-    edges = {name: _callees(lines) for name, lines in comps.items()}
-
-    # while bodies (and conditions): everything reachable from them runs
-    # once per loop iteration.
+def _loop_bodies(
+    comps: Dict[str, List[str]], edges: Dict[str, Set[str]]
+) -> Set[str]:
+    """Computations reachable from a ``while`` body or condition —
+    everything in them runs once per loop iteration."""
     loop_roots: List[str] = []
     for lines in comps.values():
         for line in lines:
@@ -191,7 +306,13 @@ def parse_hlo(hlo_text: str) -> HloModel:
                     m = re.search(key + r"=%?([\w.\-]+)", line)
                     if m:
                         loop_roots.append(m.group(1))
-    loop_bodies = _reachable(loop_roots, edges)
+    return _reachable(loop_roots, edges)
+
+
+def parse_hlo(hlo_text: str) -> HloModel:
+    comps = _split_computations(hlo_text)
+    edges = {name: _callees(lines) for name, lines in comps.items()}
+    loop_bodies = _loop_bodies(comps, edges)
 
     collectives: List[Collective] = []
     for comp, lines in comps.items():
@@ -220,3 +341,48 @@ def parse_hlo(hlo_text: str) -> HloModel:
         loop_bodies=loop_bodies,
         computations=comps,
     )
+
+
+def parse_hlo_ops(
+    hlo_text: str, phases: Sequence[str]
+) -> List[OpCost]:
+    """Every costed instruction of an optimized HLO module, with the
+    obs/annotate.py phase its ``op_name`` path carries (or None).
+
+    Wrapper/structural opcodes are skipped (module docstring); async
+    ``-done`` halves are skipped so started collectives count once.
+    Fusion outputs are counted once, at the fused computation's root.
+    """
+    comps = _split_computations(hlo_text)
+    edges = {name: _callees(lines) for name, lines in comps.items()}
+    loop_bodies = _loop_bodies(comps, edges)
+
+    ops: List[OpCost] = []
+    for comp, lines in comps.items():
+        in_loop = comp in loop_bodies
+        for line in lines:
+            m = _INSTR_RE.match(line)
+            if not m:
+                continue
+            opcode = m.group("opcode")
+            if opcode in _NO_COST_OPCODES or opcode.endswith("-done"):
+                continue
+            ometa = _META_OP_RE.search(line)
+            op_name = ometa.group(1) if ometa else ""
+            result = m.group("result")
+            ops.append(
+                OpCost(
+                    opcode=opcode,
+                    phase=phase_of(op_name, phases),
+                    flops=(
+                        0
+                        if opcode in _MOVE_OPCODES
+                        else shape_elems(result)
+                    ),
+                    bytes=shape_bytes(result),
+                    computation=comp,
+                    op_name=op_name,
+                    in_loop_body=in_loop,
+                )
+            )
+    return ops

@@ -745,6 +745,103 @@ async def cmd_fleet(args) -> int:
     return 2
 
 
+async def cmd_profile(args) -> int:
+    """``profile run|diff`` — phase-attribution profiler
+    (doc/profiling.md).  ``run`` records a flight and writes one
+    Chrome/Perfetto trace merging host spans, flight counters, and
+    per-phase device cost slices; ``diff`` decomposes the
+    fleet-vs-solo lane-round gap phase by phase (ROADMAP item 4).
+    Needs no config file: both operate on the simulator."""
+    import json as _json
+
+    from ..obs import attr
+    from ..sim.model import CONFIGS
+
+    p = CONFIGS[args.baseline](seed=args.seed)
+    if args.scale != 1.0:
+        p = p.with_(n_nodes=max(8, int(p.n_nodes * args.scale)))
+    p = p.with_(packed=not args.unpacked)
+
+    if args.profile_cmd == "run":
+        from ..obs import timeline
+        from ..sim import flight
+
+        res = flight.record_run(p, n_rounds=args.rounds)
+        flight.publish_metrics(res.flight)
+        solo = attr.profile_solo_step(p)
+        attr.publish_metrics([solo])
+        device_events: list = []
+        if args.capture_dir:
+            import jax
+
+            from ..obs import annotate
+            from ..sim import cluster
+
+            # trace under phase scopes (off by default, annotate.py) so
+            # the measured op events carry phase-named op paths
+            with annotate.scopes():
+                step = jax.jit(cluster.make_step(p, telemetry=True))
+                state = cluster.init_state(p)
+                device_events = timeline.capture_device_trace(
+                    lambda: step(state), args.capture_dir
+                )
+            if not device_events:
+                print(
+                    "profiler capture produced no Chrome trace events; "
+                    "using the cost-model phase slices",
+                    file=sys.stderr,
+                )
+        doc = timeline.build_timeline(
+            flight_rec=res.flight,
+            profiles=[solo],
+            device_events=device_events,
+        )
+        timeline.write_timeline(doc, args.out)
+        print(
+            f"wrote {args.out} ({len(doc['traceEvents'])} events, "
+            f"device track: {doc['metadata']['device_source']})",
+            file=sys.stderr,
+        )
+        print(_json.dumps(solo.to_dict(), sort_keys=True, indent=2))
+        return 0 if res.converged else 1
+
+    if args.profile_cmd == "diff":
+        # --solo / --fleet select sides; both (the documented
+        # invocation `profile diff --solo --fleet`) or neither → full
+        # per-phase decomposition of the lane-round gap
+        want_solo = args.solo or not args.fleet
+        want_fleet = args.fleet or not args.solo
+        solo = attr.profile_solo_step(p) if want_solo else None
+        fleet = (
+            attr.profile_fleet_lane(p, B=args.batch) if want_fleet else None
+        )
+        if solo is not None and fleet is not None:
+            diff = attr.diff_profiles(solo, fleet)
+            print(attr.diff_markdown(diff))
+            if args.update_benchmarks:
+                body = (
+                    attr.profiles_markdown([solo, fleet])
+                    + "\n\n### Fleet-vs-solo lane-round decomposition "
+                    + "(ROADMAP item 4)\n\n"
+                    + attr.diff_markdown(diff)
+                )
+                attr.update_benchmarks(
+                    args.update_benchmarks,
+                    body,
+                    title=f"config-{args.baseline} @ {p.n_nodes}n",
+                )
+                print(
+                    f"updated {args.update_benchmarks}", file=sys.stderr
+                )
+            return 0
+        only = solo if solo is not None else fleet
+        print(attr.profiles_markdown([only]))
+        return 0
+
+    _die(f"unknown profile subcommand {args.profile_cmd!r}")
+    return 2
+
+
 def _cell_str(cell: Any) -> str:
     if cell is None:
         return ""
@@ -1054,6 +1151,61 @@ def build_parser() -> argparse.ArgumentParser:
                             help="with --telemetry: write the "
                             "recommendation artifact here")
     sp.set_defaults(fn=cmd_fleet)
+
+    sp = sub.add_parser(
+        "profile",
+        help="phase-attribution profiler: device cost by named-scope "
+        "phase, Perfetto timeline, fleet-vs-solo diff (doc/profiling.md)",
+    )
+    psub = sp.add_subparsers(dest="profile_cmd", required=True)
+    for name, hlp in (
+        (
+            "run",
+            "record a flight and write a Chrome/Perfetto trace merging "
+            "host spans, flight counters, and device phase slices",
+        ),
+        (
+            "diff",
+            "decompose the fleet-vs-solo lane-round gap phase by phase",
+        ),
+    ):
+        pp = psub.add_parser(name, help=hlp)
+        pp.add_argument(
+            "--baseline",
+            type=int,
+            default=3,
+            choices=(1, 2, 3, 4, 5),
+            help="BASELINE config number (sim/model.py CONFIGS)",
+        )
+        pp.add_argument("--scale", type=float, default=1.0,
+                        help="scale n_nodes by this factor (min 8)")
+        pp.add_argument("--seed", type=int, default=0)
+        pp.add_argument("--unpacked", action="store_true",
+                        help="run the unpacked hot path (packed is default)")
+        if name == "run":
+            pp.add_argument("--rounds", type=int, default=None,
+                            help="scan horizon (default: the config's "
+                            "max_rounds)")
+            pp.add_argument("-o", "--out", default="timeline.trace.json",
+                            help="trace-event JSON path (load in Perfetto "
+                            "or chrome://tracing)")
+            pp.add_argument("--capture-dir", default=None, metavar="DIR",
+                            help="also attempt a programmatic jax.profiler "
+                            "capture into DIR; measured events replace the "
+                            "cost-model device track when the backend "
+                            "emits Chrome trace JSON")
+        else:
+            pp.add_argument("--solo", action="store_true",
+                            help="profile the warm solo step")
+            pp.add_argument("--fleet", action="store_true",
+                            help="profile one fleet lane (batch --batch)")
+            pp.add_argument("--batch", type=int, default=1,
+                            help="fleet lane batch width B (default 1)")
+            pp.add_argument("--update-benchmarks", default=None,
+                            metavar="MD",
+                            help="regenerate the marker-delimited 'Phase "
+                            "attribution' section of this markdown file")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("tls", help="certificate generation")
     tsub = sp.add_subparsers(dest="tls_cmd", required=True)

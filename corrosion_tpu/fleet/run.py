@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..obs.annotate import phase_scope
 from ..sim import cluster
 from ..sim import flight as flightmod
 from ..sim import profile as profilemod
@@ -128,7 +129,11 @@ def build_lane(p_static: SimParams, R: int):
         full = cluster.full_plane_for(p_static, kn.seed)
 
         def body(s, _):
-            done = (s[0] == full[None, :]).all()
+            # the per-round converged check: under vmap the cond lowers
+            # to a select, so BOTH branches execute every round — this
+            # scope is how obs/attr.py quantifies that cost (lane_gate)
+            with phase_scope("lane_gate"):
+                done = (s[0] == full[None, :]).all()
             return lax.cond(done, lambda x: (x, zeros), step, s)
 
         return lax.scan(body, state, None, length=R)

@@ -1,0 +1,35 @@
+"""Performance attribution over the hot device programs.
+
+The flight recorder (sim/flight.py) says *what* the simulator did per
+round and the comm model (analysis/comm_model.py) says what a partitioned
+program *communicates* — this package says **where the device time and
+bytes go inside the step**:
+
+- :mod:`.annotate` — the ``jax.named_scope`` phase vocabulary the step
+  pipeline (sim/cluster.py, sim/frames.py, sim/sync.py, sim/crdt.py,
+  fleet/run.py) is annotated with, so optimized-HLO op metadata carries
+  phase provenance.  Annotation is metadata-only and proven
+  non-perturbing (tests/test_obs.py).
+- :mod:`.attr` — lowers + compiles registered entries and aggregates the
+  optimized HLO per phase (flops, bytes, collective bytes, estimated
+  ms), published as ``corro.sim.phase.*`` gauges and as the
+  BENCHMARKS.md "Phase attribution" table.
+- :mod:`.timeline` — merges host spans (utils/tracing.py), flight-record
+  series and per-phase device costs into one Chrome/Perfetto
+  trace-event JSON (``corro profile run``).
+- :mod:`.regress` — compares fresh BENCH lines against the committed
+  BENCH_r*.json trajectory with explicit per-field tolerances
+  (``bench.py --check-regression``).
+
+Only :mod:`.annotate` is imported here: sim/ imports it at module load,
+and pulling :mod:`.attr` (which imports sim/ back) would cycle.
+"""
+
+from .annotate import PHASES, phase_scope, scopes_enabled, set_scopes_enabled
+
+__all__ = [
+    "PHASES",
+    "phase_scope",
+    "scopes_enabled",
+    "set_scopes_enabled",
+]

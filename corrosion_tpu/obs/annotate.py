@@ -1,0 +1,94 @@
+"""Phase vocabulary + ``jax.named_scope`` shim for the step pipeline.
+
+Every phase of the round transition (sim/model.py's numbered steps) is
+wrapped in a :func:`phase_scope` so the op metadata of the optimized HLO
+(``compiled.as_text()`` → ``metadata={... op_name="jit(step)/sync/…"}``)
+carries the phase name as a path component.  obs/attr.py parses those
+paths back out to attribute per-op cost estimates to phases.
+
+``jax.named_scope`` is metadata-only: it changes neither the jaxpr nor
+the lowered computation, so annotated programs stay bit-identical to
+unannotated ones (asserted on the five BASELINE configs, packed+framed,
+tests/test_obs.py).  Carrying the op_name paths is NOT free at build
+time, though: propagating them through tracing and the XLA pipeline
+costs ~1.7× on compile-heavy workloads (measured on the fleet test
+suite).  Scopes therefore default OFF and are enabled only where the
+metadata is consumed — obs/attr.py wraps its own lowering in
+:func:`scopes`, and ``CORRO_PHASE_SCOPES=1`` pins them on process-wide
+so an external ``jax.profiler`` capture sees phase-named ops.  The
+toggle affects fresh traces only; an already-jitted function keeps
+whatever metadata it was traced with — which is exactly how the
+non-perturbation test builds its annotated/unannotated twins.
+
+Scopes nest, and the attribution parser takes the FIRST phase component
+on the op path: the broadcast target draws self-scope as ``draw`` inside
+``draw_excluding``, so the same helper attributes to ``membership`` when
+the SWIM probe calls it and to ``sync`` when the anti-entropy peer draw
+does — only the bare broadcast-phase calls land in ``draw``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+# The phase catalogue (doc/profiling.md).  The first eight are the round
+# phases named by sim/model.py's step order; ``inject`` / ``receive``
+# cover the write-injection and chunk-accumulation scatters between
+# them, and ``lane_gate`` is fleet-only (the per-round converged check
+# whose ``lax.cond`` lowers to a select under vmap, fleet/run.py).
+PHASES = (
+    "inject",
+    "membership",
+    "draw",
+    "frames_build",
+    "frames_apply",
+    "receive",
+    "sync",
+    "crdt_merge",
+    "chaos",
+    "telemetry",
+    "lane_gate",
+)
+
+_enabled = os.environ.get("CORRO_PHASE_SCOPES", "0") != "0"
+
+
+def scopes_enabled() -> bool:
+    return _enabled
+
+
+def set_scopes_enabled(flag: bool) -> bool:
+    """Toggle phase scopes for traces built AFTER the call; returns the
+    previous setting so tests can restore it."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(flag)
+    return prev
+
+
+@contextlib.contextmanager
+def scopes(flag: bool = True):
+    """Enable (or disable) phase scopes for traces built inside the
+    block, restoring the previous setting on exit."""
+    prev = set_scopes_enabled(flag)
+    try:
+        yield
+    finally:
+        set_scopes_enabled(prev)
+
+
+def phase_scope(name: str):
+    """``jax.named_scope(name)`` when enabled, else a no-op context.
+
+    ``name`` must come from :data:`PHASES` — a typo'd scope would
+    silently fall into the unattributed bucket, so it is rejected at
+    trace time instead.
+    """
+    if name not in PHASES:
+        raise ValueError(f"unknown phase {name!r}; not in obs.annotate.PHASES")
+    if not _enabled:
+        return contextlib.nullcontext()
+    return jax.named_scope(name)

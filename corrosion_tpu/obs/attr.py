@@ -1,0 +1,431 @@
+"""Per-phase device cost attribution from optimized-HLO op metadata.
+
+The step pipeline is annotated with ``jax.named_scope`` phases
+(obs/annotate.py), so every instruction of a compiled entry carries its
+phase as a component of the ``op_name`` metadata path.  This module
+lowers + compiles the hot entries — the exact production jits, same
+buildables the semantic lint tier lowers (analysis/semantic.py) — walks
+the optimized HLO with the extended comm-model parser
+(analysis/comm_model.py :func:`~..analysis.comm_model.parse_hlo_ops`)
+and rolls per-op cost estimates up by phase:
+
+- **flops**: result element count of compute opcodes — a crude
+  arithmetic proxy, not a FMA count;
+- **bytes**: serialized result shape(s) — the write side of each op,
+  which on this memory-bound workload (uint8/uint32 planes, almost no
+  matmuls) is the quantity that predicts wall time;
+- **collective bytes**: the GL5xx collective model's per-op bytes,
+  attributed by the same op-name path;
+- **est_ms**: measured warm wall time × the phase's byte share.  The
+  byte-share model is deliberate: phases execute back-to-back in one
+  fused program, so per-phase wall time is not separately observable
+  without a hardware profiler — the share of bytes moved is the best
+  static predictor, and it is exact in the limit where every op runs at
+  the same fraction of memory bandwidth.  ``corro profile run`` swaps
+  in ``jax.profiler``-measured timings when a capture is available
+  (obs/timeline.py).
+
+Profiles publish as ``corro.sim.phase.*`` gauges (doc/telemetry.md),
+render as the BENCHMARKS.md "Phase attribution" table, and diff —
+``corro profile diff --solo --fleet`` decomposes the fleet-vs-solo
+lane-round gap (ROADMAP item 4) phase by phase.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..analysis import comm_model
+from .annotate import PHASES, scopes
+
+__all__ = [
+    "UNATTRIBUTED",
+    "PhaseCost",
+    "PhaseProfile",
+    "profile_computation",
+    "profile_solo_step",
+    "profile_fleet_lane",
+    "profile_crdt_merge",
+    "diff_profiles",
+    "diff_markdown",
+    "profiles_markdown",
+    "publish_metrics",
+    "update_benchmarks",
+]
+
+# ops whose op_name path names no phase: jit plumbing, loop carries,
+# the convergence predicate — kept visible rather than silently spread
+# across the named phases
+UNATTRIBUTED = "unattributed"
+
+BENCH_MD_BEGIN = "<!-- phase-attribution:begin -->"
+BENCH_MD_END = "<!-- phase-attribution:end -->"
+
+
+@dataclass
+class PhaseCost:
+    flops: int = 0
+    bytes: int = 0
+    collective_bytes: int = 0
+    ops: int = 0
+    est_ms: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        out = {
+            "flops": self.flops,
+            "bytes": self.bytes,
+            "collective_bytes": self.collective_bytes,
+            "ops": self.ops,
+        }
+        if self.est_ms is not None:
+            out["est_ms"] = round(self.est_ms, 6)
+        return out
+
+
+@dataclass
+class PhaseProfile:
+    """One compiled entry's per-phase cost roll-up.
+
+    ``wall_ms`` is the measured warm wall per round (solo step) or per
+    lane-round (fleet lane), when the entry was profiled with
+    ``measure=True``; ``est_ms`` per phase is its byte-share slice of
+    it.  ``loop_only=True`` means only ops inside the compiled loop
+    body were counted — the per-round cost of a scanned entry.
+    """
+
+    entry: str
+    phases: Dict[str, PhaseCost] = field(default_factory=dict)
+    wall_ms: Optional[float] = None
+    loop_only: bool = False
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(c.bytes for c in self.phases.values())
+
+    @property
+    def total_flops(self) -> int:
+        return sum(c.flops for c in self.phases.values())
+
+    def share(self, phase: str) -> float:
+        total = self.total_bytes
+        if total <= 0:
+            return 0.0
+        return self.phases.get(phase, PhaseCost()).bytes / total
+
+    def to_dict(self) -> dict:
+        out: Dict[str, Any] = {
+            "entry": self.entry,
+            "loop_only": self.loop_only,
+            "phases": {k: v.to_dict() for k, v in sorted(self.phases.items())},
+            "total_bytes": self.total_bytes,
+            "total_flops": self.total_flops,
+        }
+        if self.wall_ms is not None:
+            out["wall_ms"] = round(self.wall_ms, 6)
+        return out
+
+
+def _phase_order(profile: PhaseProfile) -> List[str]:
+    """Catalogue order, then unattributed, skipping empty phases."""
+    order = [p for p in PHASES if p in profile.phases]
+    if UNATTRIBUTED in profile.phases:
+        order.append(UNATTRIBUTED)
+    return order
+
+
+def profile_computation(
+    fn: Callable,
+    args: Tuple,
+    entry: str,
+    loop_only: bool = False,
+    wall_ms: Optional[float] = None,
+) -> PhaseProfile:
+    """Lower + compile ``fn(*args)`` and attribute its optimized HLO.
+
+    ``args`` may be abstract (``jax.eval_shape`` pytrees /
+    ``ShapeDtypeStruct``); nothing executes.  ``loop_only`` restricts
+    to ops reachable from a ``while`` body — the per-round slice of a
+    scanned entry.  ``wall_ms`` spreads a measured wall time across
+    phases by byte share (module docstring).
+    """
+    txt = fn.lower(*args).compile().as_text()
+    ops = comm_model.parse_hlo_ops(txt, PHASES)
+    hlo = comm_model.parse_hlo(txt)
+
+    phases: Dict[str, PhaseCost] = {}
+    for op in ops:
+        if loop_only and not op.in_loop_body:
+            continue
+        cost = phases.setdefault(op.phase or UNATTRIBUTED, PhaseCost())
+        cost.flops += op.flops
+        cost.bytes += op.bytes
+        cost.ops += 1
+    for c in hlo.collectives:
+        if loop_only and not c.in_loop_body:
+            continue
+        key = comm_model.phase_of(c.op_name, PHASES) or UNATTRIBUTED
+        phases.setdefault(key, PhaseCost()).collective_bytes += c.bytes
+
+    profile = PhaseProfile(
+        entry=entry, phases=phases, wall_ms=wall_ms, loop_only=loop_only
+    )
+    if wall_ms is not None:
+        for name, cost in phases.items():
+            cost.est_ms = wall_ms * profile.share(name)
+    return profile
+
+
+# -- registered entries ------------------------------------------------------
+
+
+def _warm_ms(call: Callable[[], Any], reps: int = 10) -> float:
+    """Median warm wall of ``call`` in ms (first call primes compile)."""
+    import jax
+
+    jax.block_until_ready(call())
+    samples = []
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(call())
+        samples.append((time.perf_counter() - t0) * 1e3)
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def profile_solo_step(p, measure: bool = True) -> PhaseProfile:
+    """The warm solo round: ``jax.jit(cluster.make_step(p))``."""
+    import jax
+
+    from ..sim import cluster
+
+    # phase scopes default off (compile-time cost, annotate.py); the
+    # profiler enables them around its own tracing — the fresh jit
+    # wrapper guarantees the trace happens inside the block
+    with scopes():
+        fn = jax.jit(cluster.make_step(p, telemetry=True))  # graftlint: disable=GL401 (warm-timing reps re-feed the same state buffer)
+        avals = jax.eval_shape(lambda: cluster.init_state(p))
+        wall = None
+        if measure:
+            st = cluster.init_state(p)
+            wall = _warm_ms(lambda: fn(st))
+        return profile_computation(fn, (avals,), "solo_step", wall_ms=wall)
+
+
+def _fleet_args(p, B: int):
+    import jax
+    import jax.numpy as jnp
+
+    from ..sim import cluster
+
+    state = cluster.init_state(p, batch=B)
+    kvs = (
+        jnp.full((B,), p.seed, dtype=jnp.uint32),
+        jnp.full((B,), p.fanout, dtype=jnp.int32),
+        jnp.full((B,), p.max_transmissions, dtype=jnp.int32),
+        jnp.full((B,), p.sync_interval, dtype=jnp.int32),
+        jnp.full((B,), p.write_rounds, dtype=jnp.int32),
+    )
+    return state, kvs
+
+
+def profile_fleet_lane(
+    p, R: Optional[int] = None, B: int = 1, measure: bool = True
+) -> PhaseProfile:
+    """One fleet lane-round: the scan body of ``build_fleet_fn`` at
+    batch width ``B`` (default 1 — the floor ROADMAP item 4 measures
+    against).  ``loop_only`` attribution keeps exactly the ops that run
+    once per lane-round; the measured wall divides by ``R``."""
+    import jax
+
+    from ..fleet import run as fleet_run
+
+    R = int(R if R is not None else p.max_rounds)
+    with scopes():
+        fn = fleet_run.build_fleet_fn(p, R=R, with_chaos=False)
+        state, kvs = _fleet_args(p, B)
+        avals = (jax.eval_shape(lambda: state), jax.eval_shape(lambda: kvs))
+        wall = None
+        if measure:
+            # build_fleet_fn donates the state carry, so each timed call
+            # feeds the previous call's returned state back in
+            carry = state
+
+            def call():
+                nonlocal carry
+                carry, tel = fn(carry, kvs)
+                return tel
+
+            wall = _warm_ms(call) / R
+        return profile_computation(
+            fn, avals, f"fleet_lane_b{B}", loop_only=True, wall_ms=wall
+        )
+
+
+def profile_crdt_merge(
+    p, n_keys: Optional[int] = None, measure: bool = True
+) -> PhaseProfile:
+    """The LWW register merge (sim/crdt.py) — not part of the step, so
+    it gets its own entry; this is where ``crdt_merge`` shows up."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..sim import crdt
+
+    n_keys = int(n_keys or max(1, p.n_changes // 2))
+    with scopes():
+        fn = jax.jit(lambda h: crdt.merge_registers(h, p, n_keys))  # graftlint: disable=GL401 (warm-timing reps re-feed the same have matrix)
+        have = (
+            jnp.arange(p.n_nodes * p.n_changes).reshape(p.n_nodes, p.n_changes)
+            % 3
+            == 0
+        )
+        wall = None
+        if measure:
+            wall = _warm_ms(lambda: fn(have))
+        return profile_computation(
+            fn, (jax.eval_shape(lambda: have),), "crdt_merge", wall_ms=wall
+        )
+
+
+# -- publication -------------------------------------------------------------
+
+
+def publish_metrics(profiles: List[PhaseProfile]) -> None:
+    """Publish per-phase gauges, labeled (entry, phase)."""
+    from ..utils import metrics
+
+    for prof in profiles:
+        for name, cost in prof.phases.items():
+            labels = {"entry": prof.entry, "phase": name}
+            metrics.gauge("corro.sim.phase.flops", **labels).set(cost.flops)
+            metrics.gauge("corro.sim.phase.bytes", **labels).set(cost.bytes)
+            metrics.gauge(
+                "corro.sim.phase.collective_bytes", **labels
+            ).set(cost.collective_bytes)
+            metrics.gauge("corro.sim.phase.share", **labels).set(
+                prof.share(name)
+            )
+            if cost.est_ms is not None:
+                metrics.gauge("corro.sim.phase.est_ms", **labels).set(
+                    cost.est_ms
+                )
+
+
+def profiles_markdown(profiles: List[PhaseProfile]) -> str:
+    """One markdown table over all profiles, phases in catalogue order."""
+    lines = [
+        "| entry | phase | ops | flops | bytes | coll B | share | est ms |",
+        "|---|---|---:|---:|---:|---:|---:|---:|",
+    ]
+    for prof in profiles:
+        for name in _phase_order(prof):
+            cost = prof.phases[name]
+            est = "" if cost.est_ms is None else f"{cost.est_ms:.4f}"
+            lines.append(
+                f"| {prof.entry} | {name} | {cost.ops} | {cost.flops} "
+                f"| {cost.bytes} | {cost.collective_bytes} "
+                f"| {prof.share(name):.3f} | {est} |"
+            )
+    return "\n".join(lines)
+
+
+def diff_profiles(
+    solo: PhaseProfile, fleet: PhaseProfile
+) -> Dict[str, Any]:
+    """Phase-by-phase decomposition of the fleet-vs-solo per-round gap.
+
+    Every phase present in either profile is reported; ``est_ms`` deltas
+    only exist when both sides were measured.  Phases with no solo
+    counterpart (``lane_gate``; ``sync`` every round where solo gates it
+    to 1/sync_interval rounds) are the fleet-only overhead ROADMAP item
+    4 names.
+    """
+    names = [p for p in PHASES if p in solo.phases or p in fleet.phases]
+    if UNATTRIBUTED in solo.phases or UNATTRIBUTED in fleet.phases:
+        names.append(UNATTRIBUTED)
+    empty = PhaseCost()
+    rows = []
+    for name in names:
+        s = solo.phases.get(name, empty)
+        f = fleet.phases.get(name, empty)
+        row: Dict[str, Any] = {
+            "phase": name,
+            "solo_bytes": s.bytes,
+            "fleet_bytes": f.bytes,
+            "bytes_ratio": (f.bytes / s.bytes) if s.bytes else None,
+            "solo_est_ms": s.est_ms,
+            "fleet_est_ms": f.est_ms,
+        }
+        if s.est_ms is not None and f.est_ms is not None:
+            row["delta_ms"] = f.est_ms - s.est_ms
+        elif f.est_ms is not None:
+            row["delta_ms"] = f.est_ms
+        rows.append(row)
+    out: Dict[str, Any] = {
+        "solo_entry": solo.entry,
+        "fleet_entry": fleet.entry,
+        "solo_wall_ms": solo.wall_ms,
+        "fleet_wall_ms": fleet.wall_ms,
+        "phases": rows,
+    }
+    if solo.wall_ms is not None and fleet.wall_ms is not None:
+        out["gap_ms"] = fleet.wall_ms - solo.wall_ms
+        out["gap_ratio"] = (
+            fleet.wall_ms / solo.wall_ms if solo.wall_ms else None
+        )
+    return out
+
+
+def diff_markdown(diff: Dict[str, Any]) -> str:
+    head = (
+        f"solo `{diff['solo_entry']}` vs fleet `{diff['fleet_entry']}`"
+    )
+    if diff.get("gap_ms") is not None:
+        head += (
+            f": {diff['solo_wall_ms']:.3f} ms → "
+            f"{diff['fleet_wall_ms']:.3f} ms per round "
+            f"({diff['gap_ratio']:.1f}×, +{diff['gap_ms']:.3f} ms)"
+        )
+    lines = [
+        head,
+        "",
+        "| phase | solo B | fleet B | B ratio | solo ms | fleet ms | Δ ms |",
+        "|---|---:|---:|---:|---:|---:|---:|",
+    ]
+    for row in diff["phases"]:
+        def fms(v):
+            return "" if v is None else f"{v:.4f}"
+
+        ratio = row["bytes_ratio"]
+        lines.append(
+            f"| {row['phase']} | {row['solo_bytes']} | {row['fleet_bytes']} "
+            f"| {'' if ratio is None else f'{ratio:.2f}'} "
+            f"| {fms(row['solo_est_ms'])} | {fms(row['fleet_est_ms'])} "
+            f"| {fms(row.get('delta_ms'))} |"
+        )
+    return "\n".join(lines)
+
+
+def update_benchmarks(md_path: str, body: str, title: str = "") -> None:
+    """Replace (or append) the marker-delimited "Phase attribution"
+    section of BENCHMARKS.md with ``body``."""
+    section = (
+        f"{BENCH_MD_BEGIN}\n## Phase attribution"
+        + (f" — {title}" if title else "")
+        + f"\n\n{body}\n{BENCH_MD_END}"
+    )
+    try:
+        with open(md_path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+    except FileNotFoundError:
+        text = ""
+    if BENCH_MD_BEGIN in text and BENCH_MD_END in text:
+        pre = text.split(BENCH_MD_BEGIN, 1)[0]
+        post = text.split(BENCH_MD_END, 1)[1]
+        text = pre + section + post
+    else:
+        text = text.rstrip("\n") + "\n\n" + section + "\n"
+    with open(md_path, "w", encoding="utf-8") as fh:
+        fh.write(text)

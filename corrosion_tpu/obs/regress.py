@@ -1,0 +1,207 @@
+"""Bench regression gate over the committed BENCH_r*.json trajectory.
+
+The repo carries every bench artifact it ever shipped (BENCH_r01..r12
+at this writing), one NDJSON line per (config, metric).  Nothing reads
+them back — a hot-path regression would ship silently.  This module
+closes the loop: :func:`load_baseline` indexes the trajectory (latest
+committed line per metric wins — earlier revisions are superseded
+measurements, not independent baselines), and :func:`check_lines`
+compares a fresh line field-by-field under explicit tolerances:
+
+- time-like fields regress when ``fresh > baseline × (1 + tol)``; the
+  default tolerances (:data:`DEFAULT_TOLERANCES`) are sized for warm
+  same-machine noise — warm execute ~15%, whole-run walls ~25% — so a
+  planted ≥20% warm-execute slowdown fails while re-running the
+  committed baseline passes;
+- ``converged`` regresses on true → false (a correctness cliff, no
+  tolerance);
+- improvements and unknown fields never fail the gate.
+
+``bench.py --check-regression`` runs it after a bench pass (or over an
+existing artifact via ``--lines``) and exits non-zero on regressions;
+tests/test_obs.py wires the same check into tier-1 as a cheap gate.
+Cross-machine comparisons are out of scope: the gate assumes the fresh
+line and the trajectory come from comparable hardware, which is true in
+CI and for the committed artifacts (all ``device: cpu``).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "DEFAULT_TOLERANCES",
+    "Regression",
+    "load_baseline",
+    "check_lines",
+    "check",
+    "format_report",
+]
+
+# field → fractional tolerance for time-like fields (seconds).  Only
+# listed fields are gated: compile times (cold XLA behavior drifts with
+# jax point releases) and derived ratios are informational.
+DEFAULT_TOLERANCES: Dict[str, float] = {
+    "value": 0.25,
+    "execute_s": 0.20,
+    "warm_s": 0.20,
+    "warm_execute_s": 0.15,
+    "round_s": 0.15,
+    "solo_warm_s": 0.20,
+    "cold_wall_s": 0.25,
+    "closed_loop_s": 0.25,
+}
+
+# fields too small for a relative bar to be meaningful: a 0.4 ms round
+# regressing to 0.6 ms is jitter, not a regression
+ABS_FLOOR_S = 0.05
+
+_BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+@dataclass(frozen=True)
+class Regression:
+    metric: str
+    field: str
+    baseline: float
+    fresh: float
+    tolerance: float
+    baseline_rev: str
+
+    @property
+    def ratio(self) -> float:
+        return self.fresh / self.baseline if self.baseline else float("inf")
+
+    def to_dict(self) -> dict:
+        return {
+            "metric": self.metric,
+            "field": self.field,
+            "baseline": self.baseline,
+            "fresh": self.fresh,
+            "ratio": round(self.ratio, 4),
+            "tolerance": self.tolerance,
+            "baseline_rev": self.baseline_rev,
+        }
+
+
+def _iter_lines(path: str) -> Iterable[dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and "metric" in doc:
+                yield doc
+
+
+def load_baseline(
+    repo_dir: str = ".",
+) -> Dict[str, Tuple[str, dict]]:
+    """metric → (revision, line) from the committed BENCH_r*.json
+    trajectory, latest revision winning per metric."""
+    paths = []
+    for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
+        m = _BENCH_RE.search(os.path.basename(path))
+        if m:
+            paths.append((int(m.group(1)), path))
+    out: Dict[str, Tuple[str, dict]] = {}
+    for rev, path in sorted(paths):
+        name = f"r{rev:02d}"
+        for doc in _iter_lines(path):
+            out[doc["metric"]] = (name, doc)
+    return out
+
+
+def check_lines(
+    fresh: Iterable[dict],
+    baseline: Dict[str, Tuple[str, dict]],
+    tolerances: Optional[Dict[str, float]] = None,
+) -> Tuple[List[Regression], int]:
+    """Compare fresh bench lines against the trajectory baseline.
+
+    Returns ``(regressions, checked)`` where ``checked`` counts
+    (metric, field) comparisons that had both sides.  Metrics absent
+    from the baseline are new — nothing to regress against.
+    """
+    tols = dict(DEFAULT_TOLERANCES if tolerances is None else tolerances)
+    regressions: List[Regression] = []
+    checked = 0
+    for doc in fresh:
+        got = baseline.get(doc["metric"])
+        if got is None:
+            continue
+        rev, base = got
+        for field, tol in tols.items():
+            bv, fv = base.get(field), doc.get(field)
+            if not isinstance(bv, (int, float)) or not isinstance(
+                fv, (int, float)
+            ):
+                continue
+            checked += 1
+            if bv <= ABS_FLOOR_S and fv <= ABS_FLOOR_S:
+                continue
+            if fv > bv * (1.0 + tol):
+                regressions.append(
+                    Regression(
+                        metric=doc["metric"],
+                        field=field,
+                        baseline=float(bv),
+                        fresh=float(fv),
+                        tolerance=tol,
+                        baseline_rev=rev,
+                    )
+                )
+        if base.get("converged") is True and doc.get("converged") is False:
+            checked += 1
+            regressions.append(
+                Regression(
+                    metric=doc["metric"],
+                    field="converged",
+                    baseline=1.0,
+                    fresh=0.0,
+                    tolerance=0.0,
+                    baseline_rev=rev,
+                )
+            )
+    return regressions, checked
+
+
+def check(
+    fresh: Iterable[dict],
+    repo_dir: str = ".",
+    tolerances: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """One-call gate: load the trajectory, compare, report."""
+    baseline = load_baseline(repo_dir)
+    regressions, checked = check_lines(fresh, baseline, tolerances)
+    return {
+        "ok": not regressions,
+        "checked": checked,
+        "baseline_metrics": len(baseline),
+        "regressions": [r.to_dict() for r in regressions],
+    }
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    lines = [
+        f"regression gate: {report['checked']} comparisons against "
+        f"{report['baseline_metrics']} baseline metrics"
+    ]
+    for r in report["regressions"]:
+        lines.append(
+            f"  REGRESSION {r['metric']}.{r['field']}: "
+            f"{r['baseline']:g} → {r['fresh']:g} "
+            f"({r['ratio']:.2f}x, tol {r['tolerance']:.0%}, "
+            f"baseline {r['baseline_rev']})"
+        )
+    lines.append("PASS" if report["ok"] else "FAIL")
+    return "\n".join(lines)

@@ -1,0 +1,221 @@
+"""One Chrome/Perfetto trace over host spans, flight series, and phases.
+
+``corro profile run`` merges three views of the same run into one
+trace-event JSON (the Chrome ``traceEvents`` format, loadable in
+Perfetto / ``chrome://tracing``):
+
+- **host spans** (utils/tracing.py ring buffer) as complete ``X``
+  events on the ``host`` process track — the async runtime's view;
+- **flight-record series** (sim/flight.py) as counter ``C`` events,
+  one sample per round per :data:`~corrosion_tpu.sim.model.TELEMETRY_FIELDS`
+  name — the protocol's view;
+- **per-phase device slices** (obs/attr.py) as ``X`` events laid
+  back-to-back inside each round, each phase's width its byte-share
+  slice of the measured round wall — the compiled program's view.
+
+The phase slices are a **cost model**, not a measurement: phases run
+fused inside one device program and have no individually observable
+wall time.  When a programmatic ``jax.profiler`` capture is available
+(:func:`capture_device_trace`), its trace events are merged verbatim
+instead — measured, op-level, but backend-dependent; the cost-model
+slices remain the portable fallback and are tagged
+``args.source="cost-model"`` so the two are never confused.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ..utils import tracing
+from .attr import PhaseProfile, UNATTRIBUTED
+from .annotate import PHASES
+
+__all__ = [
+    "build_timeline",
+    "capture_device_trace",
+    "phase_slices",
+    "write_timeline",
+]
+
+# stable pid/tid layout so Perfetto groups tracks predictably
+PID_HOST = 1
+PID_FLIGHT = 2
+PID_DEVICE = 3
+
+
+def _host_span_events(spans: List[Any], t0: float) -> List[dict]:
+    """Ring-buffer spans → complete events; one tid per trace id so
+    concurrent traces stack instead of overlapping."""
+    tids: Dict[str, int] = {}
+    events = []
+    for rec in spans:
+        tid = tids.setdefault(rec.trace_id, len(tids) + 1)
+        events.append(
+            {
+                "name": rec.name,
+                "ph": "X",
+                "pid": PID_HOST,
+                "tid": tid,
+                "ts": (rec.start - t0) * 1e6,
+                "dur": rec.duration * 1e6,
+                "args": dict(rec.attributes),
+            }
+        )
+    return events
+
+
+def _flight_counter_events(rec, round_us: float) -> List[dict]:
+    events = []
+    for field, vals in sorted(rec.series.items()):
+        for i, v in enumerate(vals):
+            events.append(
+                {
+                    "name": f"flight.{field}",
+                    "ph": "C",
+                    "pid": PID_FLIGHT,
+                    "tid": 1,
+                    "ts": (rec.start_round + i) * round_us,
+                    "args": {field: int(v)},
+                }
+            )
+    return events
+
+
+def phase_slices(
+    profile: PhaseProfile,
+    rounds: int,
+    round_us: Optional[float] = None,
+) -> List[dict]:
+    """Per-round phase slices from a cost profile.
+
+    Each round of width ``round_us`` (default: the profile's measured
+    wall) is tiled with one slice per phase, width proportional to the
+    phase's byte share — catalogue order, unattributed last, zero-byte
+    phases skipped.
+    """
+    if round_us is None:
+        round_us = (profile.wall_ms or 1.0) * 1e3
+    order = [p for p in PHASES if p in profile.phases]
+    if UNATTRIBUTED in profile.phases:
+        order.append(UNATTRIBUTED)
+    events = []
+    for r in range(rounds):
+        cursor = r * round_us
+        for name in order:
+            width = profile.share(name) * round_us
+            if width <= 0:
+                continue
+            events.append(
+                {
+                    "name": name,
+                    "cat": "phase",
+                    "ph": "X",
+                    "pid": PID_DEVICE,
+                    "tid": 1,
+                    "ts": cursor,
+                    "dur": width,
+                    "args": {
+                        "source": "cost-model",
+                        "entry": profile.entry,
+                        "bytes": profile.phases[name].bytes,
+                        "flops": profile.phases[name].flops,
+                    },
+                }
+            )
+            cursor += width
+    return events
+
+
+def capture_device_trace(call, trace_dir: str) -> List[dict]:
+    """Measured device events via programmatic ``jax.profiler`` capture.
+
+    Runs ``call()`` under ``jax.profiler.trace(trace_dir)`` and returns
+    any Chrome trace events the backend wrote (older jax/xprof versions
+    emit ``*.trace.json.gz`` directly).  Returns ``[]`` when the
+    profiler is unavailable or emitted only xplane protos — callers fall
+    back to the :func:`phase_slices` cost model.
+    """
+    try:
+        import jax
+        import jax.profiler  # noqa: F401
+
+        with jax.profiler.trace(trace_dir):
+            jax.block_until_ready(call())
+    except Exception:
+        return []
+    events: List[dict] = []
+    pattern = os.path.join(trace_dir, "**", "*.trace.json*")
+    for path in glob.glob(pattern, recursive=True):
+        try:
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rt", encoding="utf-8") as fh:
+                doc = json.load(fh)
+            events.extend(doc.get("traceEvents", []))
+        except Exception:
+            continue
+    return events
+
+
+def build_timeline(
+    flight_rec=None,
+    profiles: Optional[List[PhaseProfile]] = None,
+    device_events: Optional[List[dict]] = None,
+    spans: Optional[List[Any]] = None,
+) -> dict:
+    """Merge the three views into one trace-event document.
+
+    ``device_events`` (a measured capture) replaces the cost-model
+    phase slices when non-empty.  The flight counter track shares the
+    device round clock (the first profile's measured wall per round, 1
+    ms per round when nothing was measured).
+    """
+    profiles = profiles or []
+    spans = tracing.recent_spans() if spans is None else spans
+    t0 = min((s.start for s in spans), default=0.0)
+
+    round_us = 1e3
+    for prof in profiles:
+        if prof.wall_ms is not None:
+            round_us = prof.wall_ms * 1e3
+            break
+
+    events: List[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "args": {"name": label},
+        }
+        for pid, label in (
+            (PID_HOST, "host spans"),
+            (PID_FLIGHT, "flight recorder"),
+            (PID_DEVICE, "device phases"),
+        )
+    ]
+    events += _host_span_events(spans, t0)
+    if flight_rec is not None:
+        events += _flight_counter_events(flight_rec, round_us)
+    if device_events:
+        events += device_events
+    else:
+        rounds = flight_rec.n_rows if flight_rec is not None else 1
+        for prof in profiles:
+            events += phase_slices(prof, rounds=max(1, min(rounds, 64)))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "generator": "corro profile run",
+            "device_source": "measured" if device_events else "cost-model",
+            "profiles": [p.to_dict() for p in profiles],
+        },
+    }
+
+
+def write_timeline(doc: dict, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)

@@ -83,6 +83,7 @@ from .rng import (
 from . import frames as framesmod
 from . import pack
 from . import sync as syncmod
+from ..obs.annotate import phase_scope
 
 # (cov, budget, status, since, round); packed runs carry cov/budget as
 # uint32[N, Wc] / uint32[N, Wb] word planes (sim/pack.py layout)
@@ -500,11 +501,16 @@ def make_step(
         of swim_probe_attempts.  argmax over booleans returns the FIRST
         True (and index 0 when none is), exactly the sequential
         first-acceptable-else-first-candidate rule."""
-        cands = jnp.stack([draw_fn(a) for a in range(attempts)])
-        ok = jnp.logical_not(down2[view_b[None], cands])
-        first = jnp.argmax(ok, axis=0)
-        t = jnp.take_along_axis(cands, first[None], axis=0)[0]
-        return t, ok.any(axis=0)
+        # self-scoped "draw": nested under "membership"/"sync" when the
+        # probe or peer draw calls this, so first-phase-component
+        # attribution (obs/attr.py) leaves only broadcast draws here
+        with phase_scope("draw"):
+            cands = jnp.stack([draw_fn(a) for a in range(attempts)])
+            ok = jnp.logical_not(down2[view_b[None], cands])
+            first = jnp.argmax(ok, axis=0)
+            t = jnp.take_along_axis(cands, first[None], axis=0)[0]
+            found = ok.any(axis=0)
+        return t, found
 
     nvec = narange[:, None]  # [N, 1]
     kvec = karange[None, :]  # [1, K]
@@ -588,225 +594,228 @@ def make_step(
         # window-sliced plane stacks gather at the rebased row; every
         # RNG draw below stays keyed on the absolute round r, so a
         # sliced segment and the full-horizon program draw identically
-        cr = r if c_off is None else r - c_off
-        if has_chaos:
-            # liveness / restart / partition gathers into the lowered
-            # schedule tensors (constants folded into the executable)
-            alive = jnp.logical_not(c_dead[cr])
-            restarted = c_restart[cr]
-            part_active = c_pact[cr]
-        else:
-            alive = alive_at(r)
-            restarted = jnp.logical_and(
-                alive, jnp.logical_not(alive_at(r - 1))
-            )
-            # effective partition side (all-zero once healed)
-            part_active = r < p.partition_rounds
-        pvec = jnp.where(part_active, part, jnp.int8(0))
-
-        if c_drop is not None:
-            dppm = c_drop[cr]  # int32[N, N] drop probability this round
-
-            def link_up(src, dst):
-                """bool: link src→dst carries traffic this round — one
-                TAG_CHAOS_DROP draw per (round, src, dst), shared by
-                every payload on the link and by the runtime injector
-                (chaos/runtime.py makes the same py_below draw)."""
-                v = jx_below(1_000_000, c_seed, TAG_CHAOS_DROP, r, src, dst)
-                return v >= dppm[src, dst]
-        # viewer selector for draw_excluding's down2[viewer, target]
-        # gather: the partition side label in consensus mode, the node's
-        # own index in per-node mode — the indexing code is identical
-        view = narange if per_node else part.astype(jnp.int32)
-
-        # 1. inject this round's writes at their origins, full coverage
-        inj = inject_round == r
-        if p.packed:
-            # disjoint-lane scatter-ADD == scatter-OR here: colliding
-            # (row, word) entries are distinct changesets → distinct
-            # lanes, and a changeset's lane is provably zero before its
-            # inject round (nothing can deliver or sync-pull chunks of an
-            # uninjected changeset, and churn wipes only restore already-
-            # injected own writes)
-            cov = cov.at[origin, kword].add(
-                jnp.where(inj, full32 << kshift, jnp.uint32(0))
-            )
-            budget = budget.at[origin[ks_k], ks_word].add(
-                jnp.where(inj[ks_k], T32 << ks_shift, jnp.uint32(0))
-            )
-        else:
-            cov = cov.at[origin, karange].max(
-                jnp.where(inj, full[karange], jnp.uint8(0))
-            )
-            budget = budget.at[origin, karange, :].max(
-                jnp.where(inj, T8, jnp.int8(0))[:, None]
-            )
-
-        # 2. SWIM probe / suspect / refute / rejoin
-        if p.swim:
-            # shared by both view models — the probe draw keying must
-            # stay bit-identical between them (paired-randomness
-            # fidelity experiments replay these exact draws)
-            down2 = status == DOWN  # [2, N] per side, or [N, N] per node
-
-            def probe_draw(a: int):
-                suffix = () if a == 0 else (a,)
-                t = jx_below(N - 1, seed, TAG_PROBE, r, narange, *suffix)
-                return t + (t >= narange)
-
-        if per_node:
-            # -- [N, N] per-node views (model.py swim_per_node_views);
-            # mirrors reference.py's scalar loop: probes from round-start
-            # views, stage-A expiry + own probe result, stage-B gossip
-            # merge along successful probe edges via order-independent
-            # max of encoded (since*3 + state) keys, then restart seeding
-            target, found = draw_excluding(down2, narange, probe_draw)
-            probing = jnp.logical_and(alive, found)
-            # a probe crossing an active partition cut fails like a dead
-            # target would (pvec is all-zero when no partition is active,
-            # so the term vanishes and pre-partition runs are unchanged)
-            edge_ok = jnp.logical_and(alive[target], pvec == pvec[target])
-            succ_edge = jnp.logical_and(probing, edge_ok)
-            fail = jnp.logical_and(probing, jnp.logical_not(edge_ok))
-            # stage A: expiry on live viewers' rows
-            expire = jnp.logical_and(
-                status == SUSPECT, r - since >= p.swim_suspicion_rounds
-            )
-            expire = jnp.logical_and(expire, alive[:, None])
-            stA = jnp.where(expire, jnp.int8(DOWN), status)
-            sA = jnp.where(expire, r, since)
-            # own probe result at (v, target[v])
-            cur = stA[narange, target]
-            fail_to = jnp.int8(SUSPECT if p.swim_suspicion else DOWN)
-            new_st = jnp.where(
-                jnp.logical_and(succ_edge, cur != ALIVE),
-                jnp.int8(ALIVE),
-                jnp.where(jnp.logical_and(fail, cur == ALIVE), fail_to, cur),
-            )
-            changed = new_st != cur
-            stA = stA.at[narange, target].set(
-                jnp.where(probing, new_st, cur)
-            )
-            sA = sA.at[narange, target].set(
-                jnp.where(
-                    jnp.logical_and(probing, changed),
-                    r,
-                    sA[narange, target],
+        with phase_scope("chaos"):
+            cr = r if c_off is None else r - c_off
+            if has_chaos:
+                # liveness / restart / partition gathers into the lowered
+                # schedule tensors (constants folded into the executable)
+                alive = jnp.logical_not(c_dead[cr])
+                restarted = c_restart[cr]
+                part_active = c_pact[cr]
+            else:
+                alive = alive_at(r)
+                restarted = jnp.logical_and(
+                    alive, jnp.logical_not(alive_at(r - 1))
                 )
-            )
-            # stage B: key merge along edges, both directions
-            key = sA * 3 + stA.astype(jnp.int32)  # [N, N]
-            cols = narange[None, :]
-            # v adopts t's row (skip column v — self)
-            contrib_a = jnp.where(
-                jnp.logical_and(succ_edge[:, None], cols != narange[:, None]),
-                key[target],
-                jnp.int32(-1),
-            )
-            inc = jnp.maximum(key, contrib_a)
-            # t adopts v's row (skip column t — t's self); duplicate
-            # targets OR-combine through the scatter-max
-            contrib_b = jnp.where(
-                jnp.logical_and(succ_edge[:, None], cols != target[:, None]),
-                key,
-                jnp.int32(-1),
-            )
-            inc = inc.at[target].max(contrib_b)
-            status = (inc % 3).astype(jnp.int8)
-            since = inc // 3
-            # restarts: replacement row = exact current liveness; its
-            # announce reaches every live viewer this round
-            row_new = jnp.where(alive, jnp.int8(ALIVE), jnp.int8(DOWN))
-            status = jnp.where(restarted[:, None], row_new[None, :], status)
-            since = jnp.where(restarted[:, None], r, since)
-            # restart announces only cross reachable links (no-op without
-            # an active partition: pvec is all-zero then)
-            same_side = pvec[:, None] == pvec[None, :]
-            ann_col = jnp.logical_and(
-                jnp.logical_and(alive[:, None], restarted[None, :]),
-                same_side,
-            )
-            status = jnp.where(ann_col, jnp.int8(ALIVE), status)
-            since = jnp.where(ann_col, r, since)
-            # post-heal rejoin: a live viewer still holding a live node
-            # DOWN (cross-side suspicion expiry while partitioned) adopts
-            # its announce after the rejoin lag — the per-node mirror of
-            # the consensus branch's announce term.  Under pure churn
-            # this never fires: DOWN beliefs about live nodes cannot
-            # form without a partition cut (restart announces land the
-            # same round the node revives)
-            rej = jnp.logical_and(
-                jnp.logical_and(
-                    status == DOWN, r - since >= p.swim_rejoin_rounds
-                ),
-                jnp.logical_and(
-                    jnp.logical_and(alive[:, None], alive[None, :]),
-                    same_side,
-                ),
-            )
-            status = jnp.where(rej, jnp.int8(ALIVE), status)
-            since = jnp.where(rej, r, since)
-            down2 = status == DOWN
-        elif p.swim:
-            target, found = draw_excluding(down2, view, probe_draw)
-            link_ok = pvec == pvec[target]
-            probing = jnp.logical_and(alive, found)
-            succ_probe = jnp.logical_and(probing, jnp.logical_and(alive[target], link_ok))
-            fail_probe = jnp.logical_and(probing, jnp.logical_not(jnp.logical_and(alive[target], link_ok)))
+                # effective partition side (all-zero once healed)
+                part_active = r < p.partition_rounds
+            pvec = jnp.where(part_active, part, jnp.int8(0))
 
-            new_status, new_since = [], []
-            for v in range(2):
-                st_v, si_v = status[v], since[v]
-                # probes update the prober's side view while partitioned,
-                # both views otherwise (piggyback = global dissemination)
-                upd = jnp.where(part_active, part == v, True)
-                succ_v = (
-                    jnp.zeros((N,), bool)
-                    .at[target]
-                    .max(jnp.logical_and(succ_probe, upd))
+            if c_drop is not None:
+                dppm = c_drop[cr]  # int32[N, N] drop probability this round
+
+                def link_up(src, dst):
+                    """bool: link src→dst carries traffic this round — one
+                    TAG_CHAOS_DROP draw per (round, src, dst), shared by
+                    every payload on the link and by the runtime injector
+                    (chaos/runtime.py makes the same py_below draw)."""
+                    v = jx_below(1_000_000, c_seed, TAG_CHAOS_DROP, r, src, dst)
+                    return v >= dppm[src, dst]
+            # viewer selector for draw_excluding's down2[viewer, target]
+            # gather: the partition side label in consensus mode, the node's
+            # own index in per-node mode — the indexing code is identical
+            view = narange if per_node else part.astype(jnp.int32)
+
+        with phase_scope("inject"):
+            # 1. inject this round's writes at their origins, full coverage
+            inj = inject_round == r
+            if p.packed:
+                # disjoint-lane scatter-ADD == scatter-OR here: colliding
+                # (row, word) entries are distinct changesets → distinct
+                # lanes, and a changeset's lane is provably zero before its
+                # inject round (nothing can deliver or sync-pull chunks of an
+                # uninjected changeset, and churn wipes only restore already-
+                # injected own writes)
+                cov = cov.at[origin, kword].add(
+                    jnp.where(inj, full32 << kshift, jnp.uint32(0))
                 )
-                fail_v = (
-                    jnp.zeros((N,), bool)
-                    .at[target]
-                    .max(jnp.logical_and(fail_probe, upd))
+                budget = budget.at[origin[ks_k], ks_word].add(
+                    jnp.where(inj[ks_k], T32 << ks_shift, jnp.uint32(0))
                 )
-                # suspicion expiry first (timer from previous rounds)
+            else:
+                cov = cov.at[origin, karange].max(
+                    jnp.where(inj, full[karange], jnp.uint8(0))
+                )
+                budget = budget.at[origin, karange, :].max(
+                    jnp.where(inj, T8, jnp.int8(0))[:, None]
+                )
+
+        with phase_scope("membership"):
+            # 2. SWIM probe / suspect / refute / rejoin
+            if p.swim:
+                # shared by both view models — the probe draw keying must
+                # stay bit-identical between them (paired-randomness
+                # fidelity experiments replay these exact draws)
+                down2 = status == DOWN  # [2, N] per side, or [N, N] per node
+
+                def probe_draw(a: int):
+                    suffix = () if a == 0 else (a,)
+                    t = jx_below(N - 1, seed, TAG_PROBE, r, narange, *suffix)
+                    return t + (t >= narange)
+
+            if per_node:
+                # -- [N, N] per-node views (model.py swim_per_node_views);
+                # mirrors reference.py's scalar loop: probes from round-start
+                # views, stage-A expiry + own probe result, stage-B gossip
+                # merge along successful probe edges via order-independent
+                # max of encoded (since*3 + state) keys, then restart seeding
+                target, found = draw_excluding(down2, narange, probe_draw)
+                probing = jnp.logical_and(alive, found)
+                # a probe crossing an active partition cut fails like a dead
+                # target would (pvec is all-zero when no partition is active,
+                # so the term vanishes and pre-partition runs are unchanged)
+                edge_ok = jnp.logical_and(alive[target], pvec == pvec[target])
+                succ_edge = jnp.logical_and(probing, edge_ok)
+                fail = jnp.logical_and(probing, jnp.logical_not(edge_ok))
+                # stage A: expiry on live viewers' rows
                 expire = jnp.logical_and(
-                    st_v == SUSPECT, r - si_v >= p.swim_suspicion_rounds
+                    status == SUSPECT, r - since >= p.swim_suspicion_rounds
                 )
-                st2 = jnp.where(expire, jnp.int8(DOWN), st_v)
-                si2 = jnp.where(expire, r, si_v)
-                # failed probes: alive → suspect (or straight down)
+                expire = jnp.logical_and(expire, alive[:, None])
+                stA = jnp.where(expire, jnp.int8(DOWN), status)
+                sA = jnp.where(expire, r, since)
+                # own probe result at (v, target[v])
+                cur = stA[narange, target]
                 fail_to = jnp.int8(SUSPECT if p.swim_suspicion else DOWN)
-                hit = jnp.logical_and(fail_v, st2 == ALIVE)
-                st2 = jnp.where(hit, fail_to, st2)
-                si2 = jnp.where(hit, r, si2)
-                # successful probes refute (incarnation-bump alive update)
-                ref = jnp.logical_and(succ_v, st2 != ALIVE)
-                st2 = jnp.where(ref, jnp.int8(ALIVE), st2)
-                si2 = jnp.where(ref, r, si2)
-                # announce: restarts now; down-marked live nodes after the
-                # rejoin lag — reachable views only
-                reach = jnp.where(part_active, part == jnp.int8(v), True)
-                ann = jnp.logical_and(
-                    reach,
-                    jnp.logical_or(
-                        jnp.logical_and(restarted, st2 != ALIVE),
-                        jnp.logical_and(
-                            jnp.logical_and(alive, st2 == DOWN),
-                            r - si2 >= p.swim_rejoin_rounds,
-                        ),
+                new_st = jnp.where(
+                    jnp.logical_and(succ_edge, cur != ALIVE),
+                    jnp.int8(ALIVE),
+                    jnp.where(jnp.logical_and(fail, cur == ALIVE), fail_to, cur),
+                )
+                changed = new_st != cur
+                stA = stA.at[narange, target].set(
+                    jnp.where(probing, new_st, cur)
+                )
+                sA = sA.at[narange, target].set(
+                    jnp.where(
+                        jnp.logical_and(probing, changed),
+                        r,
+                        sA[narange, target],
+                    )
+                )
+                # stage B: key merge along edges, both directions
+                key = sA * 3 + stA.astype(jnp.int32)  # [N, N]
+                cols = narange[None, :]
+                # v adopts t's row (skip column v — self)
+                contrib_a = jnp.where(
+                    jnp.logical_and(succ_edge[:, None], cols != narange[:, None]),
+                    key[target],
+                    jnp.int32(-1),
+                )
+                inc = jnp.maximum(key, contrib_a)
+                # t adopts v's row (skip column t — t's self); duplicate
+                # targets OR-combine through the scatter-max
+                contrib_b = jnp.where(
+                    jnp.logical_and(succ_edge[:, None], cols != target[:, None]),
+                    key,
+                    jnp.int32(-1),
+                )
+                inc = inc.at[target].max(contrib_b)
+                status = (inc % 3).astype(jnp.int8)
+                since = inc // 3
+                # restarts: replacement row = exact current liveness; its
+                # announce reaches every live viewer this round
+                row_new = jnp.where(alive, jnp.int8(ALIVE), jnp.int8(DOWN))
+                status = jnp.where(restarted[:, None], row_new[None, :], status)
+                since = jnp.where(restarted[:, None], r, since)
+                # restart announces only cross reachable links (no-op without
+                # an active partition: pvec is all-zero then)
+                same_side = pvec[:, None] == pvec[None, :]
+                ann_col = jnp.logical_and(
+                    jnp.logical_and(alive[:, None], restarted[None, :]),
+                    same_side,
+                )
+                status = jnp.where(ann_col, jnp.int8(ALIVE), status)
+                since = jnp.where(ann_col, r, since)
+                # post-heal rejoin: a live viewer still holding a live node
+                # DOWN (cross-side suspicion expiry while partitioned) adopts
+                # its announce after the rejoin lag — the per-node mirror of
+                # the consensus branch's announce term.  Under pure churn
+                # this never fires: DOWN beliefs about live nodes cannot
+                # form without a partition cut (restart announces land the
+                # same round the node revives)
+                rej = jnp.logical_and(
+                    jnp.logical_and(
+                        status == DOWN, r - since >= p.swim_rejoin_rounds
+                    ),
+                    jnp.logical_and(
+                        jnp.logical_and(alive[:, None], alive[None, :]),
+                        same_side,
                     ),
                 )
-                st2 = jnp.where(ann, jnp.int8(ALIVE), st2)
-                si2 = jnp.where(ann, r, si2)
-                new_status.append(st2)
-                new_since.append(si2)
-            status = jnp.stack(new_status)
-            since = jnp.stack(new_since)
-            down2 = status == DOWN
-        else:
-            down2 = jnp.zeros((2, N), dtype=bool)
+                status = jnp.where(rej, jnp.int8(ALIVE), status)
+                since = jnp.where(rej, r, since)
+                down2 = status == DOWN
+            elif p.swim:
+                target, found = draw_excluding(down2, view, probe_draw)
+                link_ok = pvec == pvec[target]
+                probing = jnp.logical_and(alive, found)
+                succ_probe = jnp.logical_and(probing, jnp.logical_and(alive[target], link_ok))
+                fail_probe = jnp.logical_and(probing, jnp.logical_not(jnp.logical_and(alive[target], link_ok)))
+
+                new_status, new_since = [], []
+                for v in range(2):
+                    st_v, si_v = status[v], since[v]
+                    # probes update the prober's side view while partitioned,
+                    # both views otherwise (piggyback = global dissemination)
+                    upd = jnp.where(part_active, part == v, True)
+                    succ_v = (
+                        jnp.zeros((N,), bool)
+                        .at[target]
+                        .max(jnp.logical_and(succ_probe, upd))
+                    )
+                    fail_v = (
+                        jnp.zeros((N,), bool)
+                        .at[target]
+                        .max(jnp.logical_and(fail_probe, upd))
+                    )
+                    # suspicion expiry first (timer from previous rounds)
+                    expire = jnp.logical_and(
+                        st_v == SUSPECT, r - si_v >= p.swim_suspicion_rounds
+                    )
+                    st2 = jnp.where(expire, jnp.int8(DOWN), st_v)
+                    si2 = jnp.where(expire, r, si_v)
+                    # failed probes: alive → suspect (or straight down)
+                    fail_to = jnp.int8(SUSPECT if p.swim_suspicion else DOWN)
+                    hit = jnp.logical_and(fail_v, st2 == ALIVE)
+                    st2 = jnp.where(hit, fail_to, st2)
+                    si2 = jnp.where(hit, r, si2)
+                    # successful probes refute (incarnation-bump alive update)
+                    ref = jnp.logical_and(succ_v, st2 != ALIVE)
+                    st2 = jnp.where(ref, jnp.int8(ALIVE), st2)
+                    si2 = jnp.where(ref, r, si2)
+                    # announce: restarts now; down-marked live nodes after the
+                    # rejoin lag — reachable views only
+                    reach = jnp.where(part_active, part == jnp.int8(v), True)
+                    ann = jnp.logical_and(
+                        reach,
+                        jnp.logical_or(
+                            jnp.logical_and(restarted, st2 != ALIVE),
+                            jnp.logical_and(
+                                jnp.logical_and(alive, st2 == DOWN),
+                                r - si2 >= p.swim_rejoin_rounds,
+                            ),
+                        ),
+                    )
+                    st2 = jnp.where(ann, jnp.int8(ALIVE), st2)
+                    si2 = jnp.where(ann, r, si2)
+                    new_status.append(st2)
+                    new_since.append(si2)
+                status = jnp.stack(new_status)
+                since = jnp.stack(new_since)
+                down2 = status == DOWN
+            else:
+                down2 = jnp.zeros((2, N), dtype=bool)
 
         # 3. broadcast: each held chunk of each budgeted changeset is an
         # independent payload fanned out to `fanout` (distinct, on the
@@ -818,7 +827,8 @@ def make_step(
             # pend bits come straight off the word planes via lane shift
             # algebra — shared by the framed frame build and the dense
             # scatter planes, and by the receive-phase budget decrement
-            pend_lsb = pack.lane_nonzero(budget, bb)  # [N, Wb] LSB flags
+            with phase_scope("frames_build"):
+                pend_lsb = pack.lane_nonzero(budget, bb)  # [N, Wb] flags
         if telemetry:
             # sends = payloads dispatched to a FOUND (believed-up) target,
             # before delivery gating — what the runtime's
@@ -830,14 +840,21 @@ def make_step(
             # chunk AND its budget lane is nonzero — and each (chunk,
             # fanout) slot contributes flat frame rows instead of a dense
             # [N, K] scatter plane
-            if p.packed:
-                pend_w = jnp.where(alive[:, None], pend_lsb, jnp.uint32(0))
-                hold_w = cov & pack.chunk_flags_to_cov_words(pend_w, p)
-            else:
-                pend = jnp.logical_and(budget > 0, alive[:, None, None])
-                hold_w = pack.pack_cov(cov, p) & pack.chunk_flags_to_cov_words(
-                    pack.pack_chunk_flags(pend, p), p
-                )
+            with phase_scope("frames_build"):
+                if p.packed:
+                    pend_w = jnp.where(
+                        alive[:, None], pend_lsb, jnp.uint32(0)
+                    )
+                    hold_w = cov & pack.chunk_flags_to_cov_words(pend_w, p)
+                else:
+                    pend = jnp.logical_and(
+                        budget > 0, alive[:, None, None]
+                    )
+                    hold_w = pack.pack_cov(
+                        cov, p
+                    ) & pack.chunk_flags_to_cov_words(
+                        pack.pack_chunk_flags(pend, p), p
+                    )
 
             def bcast_framed(_):
                 """Draws + frame build + segmented-OR apply.  Runs under
@@ -859,9 +876,12 @@ def make_step(
                         # entry frame: per-payload targets [N, K]; the
                         # value is the payload's single chunk bit in word
                         # space, the key its flat (target, kword) cell
-                        hk = hold_s[:, f_kword]  # [N, K] word per payload
-                        bitm = jnp.uint32(1) << (f_kshift + jnp.uint32(s))
-                        val_nk = hk & bitm[None, :]
+                        with phase_scope("frames_build"):
+                            hk = hold_s[:, f_kword]  # [N, K] payload words
+                            bitm = jnp.uint32(1) << (
+                                f_kshift + jnp.uint32(s)
+                            )
+                            val_nk = hk & bitm[None, :]
                         chosen = []
                         for j in range(p.fanout):
                             slot = j * S + s
@@ -872,34 +892,38 @@ def make_step(
                                     chosen
                                 ): bcast_target(r, slot, a, ch),
                             )
-                            ok = jnp.logical_and(
-                                jnp.logical_and(
-                                    found, pvec[:, None] == pvec[t]
-                                ),
-                                alive[t],
-                            )
-                            ok = slot_on(j, ok)
-                            if c_drop is not None:
-                                # lowered drop planes filter the FRAME:
-                                # the row value is zeroed before it
-                                # enters the segment combine (same
-                                # per-link draw as the dense path)
-                                ok = jnp.logical_and(ok, link_up(nvec, t))
-                            if telemetry:
-                                tel = tel + jnp.logical_and(
-                                    val_nk != 0, slot_on(j, found)
-                                ).sum(dtype=jnp.int32)
-                            keys_l.append(
-                                (
-                                    t.astype(jnp.int32) * f_wc
-                                    + f_kword[None, :]
-                                ).reshape(-1)
-                            )
-                            vals_l.append(
-                                jnp.where(
-                                    ok, val_nk, jnp.uint32(0)
-                                ).reshape(-1)
-                            )
+                            with phase_scope("frames_build"):
+                                ok = jnp.logical_and(
+                                    jnp.logical_and(
+                                        found, pvec[:, None] == pvec[t]
+                                    ),
+                                    alive[t],
+                                )
+                                ok = slot_on(j, ok)
+                                if c_drop is not None:
+                                    # lowered drop planes filter the
+                                    # FRAME: the row value is zeroed
+                                    # before it enters the segment
+                                    # combine (same per-link draw as
+                                    # the dense path)
+                                    ok = jnp.logical_and(
+                                        ok, link_up(nvec, t)
+                                    )
+                                if telemetry:
+                                    tel = tel + jnp.logical_and(
+                                        val_nk != 0, slot_on(j, found)
+                                    ).sum(dtype=jnp.int32)
+                                keys_l.append(
+                                    (
+                                        t.astype(jnp.int32) * f_wc
+                                        + f_kword[None, :]
+                                    ).reshape(-1)
+                                )
+                                vals_l.append(
+                                    jnp.where(
+                                        ok, val_nk, jnp.uint32(0)
+                                    ).reshape(-1)
+                                )
                             chosen.append(t)
                     else:
                         for j in range(p.fanout):
@@ -911,41 +935,49 @@ def make_step(
                                     r, slot, a
                                 ),
                             )
-                            ok = jnp.logical_and(
-                                jnp.logical_and(found, pvec == pvec[t]),
-                                alive[t],
-                            )
-                            ok = slot_on(j, ok)
-                            if c_drop is not None:
+                            with phase_scope("frames_build"):
                                 ok = jnp.logical_and(
-                                    ok, link_up(narange, t)
+                                    jnp.logical_and(
+                                        found, pvec == pvec[t]
+                                    ),
+                                    alive[t],
                                 )
-                            if telemetry:
-                                tel = tel + pack.popcount32(
-                                    jnp.where(
-                                        slot_on(j, found)[:, None],
-                                        hold_s,
-                                        jnp.uint32(0),
+                                ok = slot_on(j, ok)
+                                if c_drop is not None:
+                                    ok = jnp.logical_and(
+                                        ok, link_up(narange, t)
                                     )
-                                ).sum()
-                            # row frame: the sender's whole chunk-s word
-                            # row rides to one target — every payload on
-                            # the link in a single segment-OR row
-                            keys_l.append(t.astype(jnp.int32))
-                            vals_l.append(
-                                jnp.where(
-                                    ok[:, None], hold_s, jnp.uint32(0)
+                                if telemetry:
+                                    tel = tel + pack.popcount32(
+                                        jnp.where(
+                                            slot_on(j, found)[:, None],
+                                            hold_s,
+                                            jnp.uint32(0),
+                                        )
+                                    ).sum()
+                                # row frame: the sender's whole chunk-s
+                                # word row rides to one target — every
+                                # payload on the link in a single
+                                # segment-OR row
+                                keys_l.append(t.astype(jnp.int32))
+                                vals_l.append(
+                                    jnp.where(
+                                        ok[:, None], hold_s, jnp.uint32(0)
+                                    )
                                 )
-                            )
-                keys = jnp.concatenate(keys_l)
-                vals = jnp.concatenate(vals_l, axis=0)
-                if p.fanout_per_change:
-                    dw = framesmod.apply_entry_frame(keys, vals, N, f_wc)
-                else:
-                    dw = framesmod.apply_row_frame(keys, vals, N)
+                with phase_scope("frames_apply"):
+                    keys = jnp.concatenate(keys_l)
+                    vals = jnp.concatenate(vals_l, axis=0)
+                    if p.fanout_per_change:
+                        dw = framesmod.apply_entry_frame(
+                            keys, vals, N, f_wc
+                        )
+                    else:
+                        dw = framesmod.apply_row_frame(keys, vals, N)
                 return dw, tel
 
-            traffic = jnp.any(hold_w != jnp.uint32(0))
+            with phase_scope("frames_build"):
+                traffic = jnp.any(hold_w != jnp.uint32(0))
             delivered_w, tel_b = lax.cond(
                 traffic,
                 bcast_framed,
@@ -958,29 +990,35 @@ def make_step(
             if telemetry:
                 tel_bcast = tel_b
             if not p.packed:
-                delivered = pack.unpack_cov(delivered_w, p)
+                with phase_scope("frames_apply"):
+                    delivered = pack.unpack_cov(delivered_w, p)
         else:
-            if p.packed:
-                # dense path: unpack transients feed the per-changeset
-                # scatter planes; only those planes and their uint8
-                # accumulator are per-changeset, and they are transients
-                # fused into the scatter — not live state
-                pend = jnp.logical_and(
-                    pack.unpack_budget(pend_lsb, p) != 0,
-                    alive[:, None, None],
-                )
-                covu = pack.unpack_cov(cov, p)  # transient lane values
-            else:
-                pend = jnp.logical_and(budget > 0, alive[:, None, None])
-                covu = cov
-            delivered = jnp.zeros((N, K), dtype=jnp.uint8)
-            kk = jnp.broadcast_to(kvec, (N, K))
+            with phase_scope("frames_build"):
+                if p.packed:
+                    # dense path: unpack transients feed the
+                    # per-changeset scatter planes; only those planes
+                    # and their uint8 accumulator are per-changeset,
+                    # and they are transients fused into the scatter —
+                    # not live state
+                    pend = jnp.logical_and(
+                        pack.unpack_budget(pend_lsb, p) != 0,
+                        alive[:, None, None],
+                    )
+                    covu = pack.unpack_cov(cov, p)  # transient lanes
+                else:
+                    pend = jnp.logical_and(
+                        budget > 0, alive[:, None, None]
+                    )
+                    covu = cov
+                delivered = jnp.zeros((N, K), dtype=jnp.uint8)
+                kk = jnp.broadcast_to(kvec, (N, K))
             for s in range(S):
-                bit = jnp.uint8(1 << s)
-                plane = jnp.zeros((N, K), dtype=bool)
-                hold = jnp.logical_and(
-                    pend[:, :, s], (covu & bit).astype(bool)
-                )
+                with phase_scope("frames_build"):
+                    bit = jnp.uint8(1 << s)
+                    plane = jnp.zeros((N, K), dtype=bool)
+                    hold = jnp.logical_and(
+                        pend[:, :, s], (covu & bit).astype(bool)
+                    )
                 if p.fanout_per_change:
                     chosen = []
                     for j in range(p.fanout):
@@ -992,18 +1030,22 @@ def make_step(
                                 chosen
                             ): bcast_target(r, slot, a, ch),
                         )
-                        ok = jnp.logical_and(
-                            jnp.logical_and(found, pvec[:, None] == pvec[t]),
-                            alive[t],
-                        )
-                        ok = slot_on(j, ok)
-                        if c_drop is not None:
-                            ok = jnp.logical_and(ok, link_up(nvec, t))
-                        if telemetry:
-                            tel_bcast = tel_bcast + jnp.logical_and(
-                                hold, slot_on(j, found)
-                            ).sum(dtype=jnp.int32)
-                        plane = plane.at[t, kk].max(hold & ok)
+                        with phase_scope("frames_build"):
+                            ok = jnp.logical_and(
+                                jnp.logical_and(
+                                    found, pvec[:, None] == pvec[t]
+                                ),
+                                alive[t],
+                            )
+                            ok = slot_on(j, ok)
+                            if c_drop is not None:
+                                ok = jnp.logical_and(ok, link_up(nvec, t))
+                            if telemetry:
+                                tel_bcast = tel_bcast + jnp.logical_and(
+                                    hold, slot_on(j, found)
+                                ).sum(dtype=jnp.int32)
+                        with phase_scope("frames_apply"):
+                            plane = plane.at[t, kk].max(hold & ok)
                         chosen.append(t)
                 else:
                     for j in range(p.fanout):
@@ -1015,250 +1057,262 @@ def make_step(
                                 r, slot, a
                             ),
                         )
-                        ok = jnp.logical_and(
-                            jnp.logical_and(found, pvec == pvec[t]), alive[t]
-                        )
-                        ok = slot_on(j, ok)
-                        if c_drop is not None:
-                            ok = jnp.logical_and(ok, link_up(narange, t))
-                        if telemetry:
-                            tel_bcast = tel_bcast + jnp.logical_and(
-                                hold, slot_on(j, found)[:, None]
-                            ).sum(dtype=jnp.int32)
-                        plane = plane.at[t].max(hold & ok[:, None])
-                delivered = delivered | jnp.where(plane, bit, jnp.uint8(0))
-
-        # 4. receive: accumulate chunks; a newly received chunk refreshes
-        # ITS OWN budget only (one pending payload per chunk, like the
-        # runtime); every pending chunk that sent this round decrements
-        if p.packed:
-            if not p.framed:
-                delivered_w = pack.pack_cov(delivered, p)
-            new_w = delivered_w & ~cov
-            new_w = jnp.where(alive[:, None], new_w, jnp.uint32(0))
-            cov = cov | new_w
-            if telemetry:
-                tel_deliv = pack.popcount32(new_w).sum()
-            # budget-layout lane-LSB flags of the newly landed chunks
-            new_f = pack.cov_words_to_chunk_flags(new_w, p)
-            pend_f = jnp.where(alive[:, None], pend_lsb, jnp.uint32(0))
-            # decrement pending lanes that sent — each such lane is ≥ 1,
-            # so no borrow crosses a lane boundary — then clear + refresh
-            # the newly-received lanes to max_transmissions
-            budget = budget - (pend_f & ~new_f)
-            budget = (budget & ~pack.lane_fill(new_f, bb)) | new_f * T32
-        else:
-            new_bits = delivered & ~cov
-            new_bits = jnp.where(alive[:, None], new_bits, 0)
-            cov = cov | new_bits
-            if telemetry:
-                tel_deliv = pack.popcount32(new_bits.astype(jnp.uint32)).sum()
-            chunk_bits = jnp.asarray(
-                [1 << s for s in range(S)], dtype=jnp.uint8
-            )
-            new_per_chunk = (
-                new_bits[:, :, None] & chunk_bits[None, None, :]
-            ) != 0
-            budget = jnp.where(
-                new_per_chunk,
-                T8,
-                jnp.where(pend, budget - jnp.int8(1), budget),
-            )
-
-        # 5. anti-entropy: budgeted needs-based pull from one peer
-        if telemetry:
-            tel_sync_sess = jnp.int32(0)
-            tel_sync_chunks = jnp.int32(0)
-        if p.sync_interval > 0:
-
-            def sync_draw(a: int):
-                suffix = () if a == 0 else (a,)
-                q = jx_below(N - 1, seed, TAG_SYNC, r, narange, *suffix)
-                return q + (q >= narange)
-
-            q, found = draw_excluding(down2, view, sync_draw)
-            okq = jnp.logical_and(
-                jnp.logical_and(found, pvec == pvec[q]),
-                jnp.logical_and(alive, alive[q]),
-            )
-            if c_drop is not None:
-                # the whole pull session rides the initiator→peer link
-                okq = jnp.logical_and(okq, link_up(narange, q))
-
-            def sync_pull(c):
-                """Needs algebra + pull on whichever cov layout rides the
-                carry.  Runs under ``lax.cond``, so the off rounds skip
-                the [N]-row gather and the needs arithmetic entirely
-                instead of computing-then-masking them (sync_interval−1
-                of every sync_interval rounds); the counter-based RNG
-                consumes no state, so skipping draws is trajectory-free.
-                """
-                if p.packed:
-                    # the needs rule stays in word space end to end: the
-                    # above-head case is a pointer-jumped suffix-OR over
-                    # uint8 seen flags inside jx_available_packed — no
-                    # per-(node, actor) heads tensor, no [N, K] int32
-                    if fleet:
-                        # traced next-version map (the host map needs the
-                        # concrete seed)
-                        avail = syncmod.jx_available_packed(
-                            c, c[q], full_w, p, nxt=nxt_t, steps=steps_t
-                        )
-                    else:
-                        avail = syncmod.jx_available_packed(
-                            c, c[q], full_w, p
-                        )
-                    if p.sync_chunk_budget > 0:
-                        # the (version, seq)-ordered cumsum cap wants
-                        # per-changeset masks; transient unpack/repack
-                        pulled = pack.pack_cov(
-                            syncmod.jx_budget_transfer(
-                                pack.unpack_cov(avail, p),
-                                p.sync_chunk_budget,
-                            ),
-                            p,
-                        )
-                    else:
-                        pulled = avail
-                else:
-                    if fleet:
-                        avail = syncmod.jx_available_nextmap(
-                            c, c[q], full, nxt_t, steps_t
-                        )
-                    else:
-                        heads_mine = syncmod.jx_heads(
-                            c, aidx, vidx, n_actors
-                        )
-                        avail = syncmod.jx_available(
-                            c, c[q], full, heads_mine, aidx, vidx
-                        )
-                    pulled = syncmod.jx_budget_transfer(
-                        avail, p.sync_chunk_budget
+                        with phase_scope("frames_build"):
+                            ok = jnp.logical_and(
+                                jnp.logical_and(found, pvec == pvec[t]),
+                                alive[t],
+                            )
+                            ok = slot_on(j, ok)
+                            if c_drop is not None:
+                                ok = jnp.logical_and(
+                                    ok, link_up(narange, t)
+                                )
+                            if telemetry:
+                                tel_bcast = tel_bcast + jnp.logical_and(
+                                    hold, slot_on(j, found)[:, None]
+                                ).sum(dtype=jnp.int32)
+                        with phase_scope("frames_apply"):
+                            plane = plane.at[t].max(hold & ok[:, None])
+                with phase_scope("frames_apply"):
+                    delivered = delivered | jnp.where(
+                        plane, bit, jnp.uint8(0)
                     )
-                # sync sessions are identity-keyed frames (node n pulls
-                # into row n), so the frame apply degenerates to the
-                # sort-free masked OR — sim/frames.py owns the algebra
-                return framesmod.identity_frame_apply(c, okq, pulled)
 
-            if fleet:
-                # lanes may sweep sync_interval down to 0 (sync off);
-                # the modulus is clamped so XLA never divides by zero on
-                # the dead branch of the select
-                due = jnp.logical_and(
-                    si32 > 0, (r + 1) % jnp.maximum(si32, 1) == 0
-                )
-            else:
-                due = (r + 1) % p.sync_interval == 0
-            if telemetry:
-                # widen the cond's carry with (sessions, chunks pulled) so
-                # the stats ride OUT of the gated branch; the off-round
-                # branch returns matching zeros, and the record=False
-                # build above keeps the original single-output cond
-                def sync_pull_tel(c):
-                    c2 = sync_pull(c)
-                    delta = c2 ^ c
-                    if not p.packed:
-                        delta = delta.astype(jnp.uint32)
-                    return c2, okq.sum(dtype=jnp.int32), pack.popcount32(delta).sum()
-
-                cov, tel_sync_sess, tel_sync_chunks = lax.cond(
-                    due,
-                    sync_pull_tel,
-                    lambda c: (c, jnp.int32(0), jnp.int32(0)),
-                    cov,
-                )
-            else:
-                cov = lax.cond(due, sync_pull, lambda c: c, cov)
-
-        # 6. churn: deaths wipe to own writes (replacement node
-        # re-registering); the node stays unresponsive for D rounds.
-        # Hash-selected under the ad-hoc scalars, schedule-driven under
-        # an explicit chaos schedule
-        die = None
-        if has_die:
-            die = c_die[cr]
-        elif (not has_chaos) and p.churn_ppm > 0 and p.churn_rounds > 0:
-            die = death(r)
-        # graftlint: disable=GL101 (identity check on whether a wipe plane exists this trace — decided at trace time, not a tracer comparison)
-        if die is not None:
-            # own[n, k]: changeset k originates at n (restart survivors);
-            # computed in-step so it fuses instead of sitting as an [N, K]
-            # constant in the executable
-            own = origin[None, :] == narange[:, None]
-            own_now = jnp.logical_and(own, inject_round[None, :] <= r)
+        with phase_scope("receive"):
+            # 4. receive: accumulate chunks; a newly received chunk refreshes
+            # ITS OWN budget only (one pending payload per chunk, like the
+            # runtime); every pending chunk that sent this round decrements
             if p.packed:
-                own_cov = pack.pack_cov(
-                    jnp.where(own_now, full[None, :], jnp.uint8(0)), p
-                )
-                cov = jnp.where(die[:, None], own_cov, cov)
-                own_f = pack.pack_chunk_flags(
-                    jnp.broadcast_to(own_now[:, :, None], (N, K, S)), p
-                )
-                budget = jnp.where(die[:, None], own_f * T32, budget)
+                if not p.framed:
+                    delivered_w = pack.pack_cov(delivered, p)
+                new_w = delivered_w & ~cov
+                new_w = jnp.where(alive[:, None], new_w, jnp.uint32(0))
+                cov = cov | new_w
+                if telemetry:
+                    tel_deliv = pack.popcount32(new_w).sum()
+                # budget-layout lane-LSB flags of the newly landed chunks
+                new_f = pack.cov_words_to_chunk_flags(new_w, p)
+                pend_f = jnp.where(alive[:, None], pend_lsb, jnp.uint32(0))
+                # decrement pending lanes that sent — each such lane is ≥ 1,
+                # so no borrow crosses a lane boundary — then clear + refresh
+                # the newly-received lanes to max_transmissions
+                budget = budget - (pend_f & ~new_f)
+                budget = (budget & ~pack.lane_fill(new_f, bb)) | new_f * T32
             else:
-                own_cov = jnp.where(own_now, full[None, :], 0).astype(jnp.uint8)
-                cov = jnp.where(die[:, None], own_cov, cov)
-                budget = jnp.where(
-                    die[:, None, None],
-                    jnp.where(own_now[:, :, None], T8, jnp.int8(0)),
-                    budget,
+                new_bits = delivered & ~cov
+                new_bits = jnp.where(alive[:, None], new_bits, 0)
+                cov = cov | new_bits
+                if telemetry:
+                    tel_deliv = pack.popcount32(new_bits.astype(jnp.uint32)).sum()
+                chunk_bits = jnp.asarray(
+                    [1 << s for s in range(S)], dtype=jnp.uint8
                 )
+                new_per_chunk = (
+                    new_bits[:, :, None] & chunk_bits[None, None, :]
+                ) != 0
+                budget = jnp.where(
+                    new_per_chunk,
+                    T8,
+                    jnp.where(pend, budget - jnp.int8(1), budget),
+                )
+
+        with phase_scope("sync"):
+            # 5. anti-entropy: budgeted needs-based pull from one peer
+            if telemetry:
+                tel_sync_sess = jnp.int32(0)
+                tel_sync_chunks = jnp.int32(0)
+            if p.sync_interval > 0:
+
+                def sync_draw(a: int):
+                    suffix = () if a == 0 else (a,)
+                    q = jx_below(N - 1, seed, TAG_SYNC, r, narange, *suffix)
+                    return q + (q >= narange)
+
+                q, found = draw_excluding(down2, view, sync_draw)
+                okq = jnp.logical_and(
+                    jnp.logical_and(found, pvec == pvec[q]),
+                    jnp.logical_and(alive, alive[q]),
+                )
+                if c_drop is not None:
+                    # the whole pull session rides the initiator→peer link
+                    okq = jnp.logical_and(okq, link_up(narange, q))
+
+                def sync_pull(c):
+                    """Needs algebra + pull on whichever cov layout rides the
+                    carry.  Runs under ``lax.cond``, so the off rounds skip
+                    the [N]-row gather and the needs arithmetic entirely
+                    instead of computing-then-masking them (sync_interval−1
+                    of every sync_interval rounds); the counter-based RNG
+                    consumes no state, so skipping draws is trajectory-free.
+                    """
+                    if p.packed:
+                        # the needs rule stays in word space end to end: the
+                        # above-head case is a pointer-jumped suffix-OR over
+                        # uint8 seen flags inside jx_available_packed — no
+                        # per-(node, actor) heads tensor, no [N, K] int32
+                        if fleet:
+                            # traced next-version map (the host map needs the
+                            # concrete seed)
+                            avail = syncmod.jx_available_packed(
+                                c, c[q], full_w, p, nxt=nxt_t, steps=steps_t
+                            )
+                        else:
+                            avail = syncmod.jx_available_packed(
+                                c, c[q], full_w, p
+                            )
+                        if p.sync_chunk_budget > 0:
+                            # the (version, seq)-ordered cumsum cap wants
+                            # per-changeset masks; transient unpack/repack
+                            pulled = pack.pack_cov(
+                                syncmod.jx_budget_transfer(
+                                    pack.unpack_cov(avail, p),
+                                    p.sync_chunk_budget,
+                                ),
+                                p,
+                            )
+                        else:
+                            pulled = avail
+                    else:
+                        if fleet:
+                            avail = syncmod.jx_available_nextmap(
+                                c, c[q], full, nxt_t, steps_t
+                            )
+                        else:
+                            heads_mine = syncmod.jx_heads(
+                                c, aidx, vidx, n_actors
+                            )
+                            avail = syncmod.jx_available(
+                                c, c[q], full, heads_mine, aidx, vidx
+                            )
+                        pulled = syncmod.jx_budget_transfer(
+                            avail, p.sync_chunk_budget
+                        )
+                    # sync sessions are identity-keyed frames (node n pulls
+                    # into row n), so the frame apply degenerates to the
+                    # sort-free masked OR — sim/frames.py owns the algebra
+                    return framesmod.identity_frame_apply(c, okq, pulled)
+
+                if fleet:
+                    # lanes may sweep sync_interval down to 0 (sync off);
+                    # the modulus is clamped so XLA never divides by zero on
+                    # the dead branch of the select
+                    due = jnp.logical_and(
+                        si32 > 0, (r + 1) % jnp.maximum(si32, 1) == 0
+                    )
+                else:
+                    due = (r + 1) % p.sync_interval == 0
+                if telemetry:
+                    # widen the cond's carry with (sessions, chunks pulled) so
+                    # the stats ride OUT of the gated branch; the off-round
+                    # branch returns matching zeros, and the record=False
+                    # build above keeps the original single-output cond
+                    def sync_pull_tel(c):
+                        c2 = sync_pull(c)
+                        delta = c2 ^ c
+                        if not p.packed:
+                            delta = delta.astype(jnp.uint32)
+                        return c2, okq.sum(dtype=jnp.int32), pack.popcount32(delta).sum()
+
+                    cov, tel_sync_sess, tel_sync_chunks = lax.cond(
+                        due,
+                        sync_pull_tel,
+                        lambda c: (c, jnp.int32(0), jnp.int32(0)),
+                        cov,
+                    )
+                else:
+                    cov = lax.cond(due, sync_pull, lambda c: c, cov)
+
+        with phase_scope("chaos"):
+            # 6. churn: deaths wipe to own writes (replacement node
+            # re-registering); the node stays unresponsive for D rounds.
+            # Hash-selected under the ad-hoc scalars, schedule-driven under
+            # an explicit chaos schedule
+            die = None
+            if has_die:
+                die = c_die[cr]
+            elif (not has_chaos) and p.churn_ppm > 0 and p.churn_rounds > 0:
+                die = death(r)
+            # graftlint: disable=GL101 (identity check on whether a wipe plane exists this trace — decided at trace time, not a tracer comparison)
+            if die is not None:
+                # own[n, k]: changeset k originates at n (restart survivors);
+                # computed in-step so it fuses instead of sitting as an [N, K]
+                # constant in the executable
+                own = origin[None, :] == narange[:, None]
+                own_now = jnp.logical_and(own, inject_round[None, :] <= r)
+                if p.packed:
+                    own_cov = pack.pack_cov(
+                        jnp.where(own_now, full[None, :], jnp.uint8(0)), p
+                    )
+                    cov = jnp.where(die[:, None], own_cov, cov)
+                    own_f = pack.pack_chunk_flags(
+                        jnp.broadcast_to(own_now[:, :, None], (N, K, S)), p
+                    )
+                    budget = jnp.where(die[:, None], own_f * T32, budget)
+                else:
+                    own_cov = jnp.where(own_now, full[None, :], 0).astype(jnp.uint8)
+                    cov = jnp.where(die[:, None], own_cov, cov)
+                    budget = jnp.where(
+                        die[:, None, None],
+                        jnp.where(own_now[:, :, None], T8, jnp.int8(0)),
+                        budget,
+                    )
         if not telemetry:
             return cov, budget, status, since, r + 1
 
-        # 7. flight-recorder reductions on the POST-round planes (word
-        # space when packed); defined to match what the runtime's counters
-        # observe at a DevCluster round barrier (chaos/compare.py parity)
-        if p.packed:
-            notc = pack.lane_nonzero(cov ^ full_w[None, :], cb)
-            cflags = valid_w[None, :] & ~notc
-            complete_pairs = pack.popcount32(cflags).sum()
-            nodes_complete = jnp.sum(
-                jnp.all(cflags == valid_w[None, :], axis=1), dtype=jnp.int32
-            )
-            budget_remaining = pack.lane_sum(budget, bb).sum()
-        else:
-            cmask = cov == full[None, :]
-            complete_pairs = jnp.sum(cmask, dtype=jnp.int32)
-            nodes_complete = jnp.sum(
-                jnp.all(cmask, axis=1), dtype=jnp.int32
-            )
-            budget_remaining = jnp.sum(budget, dtype=jnp.int32)
-        # members_up: the sim twin of summing len(up_members()) over live
-        # runtime nodes — others not believed DOWN, through each live
-        # node's own view row (per-node) or its side's consensus view
-        not_down = status != DOWN
-        if per_node:
-            cnt = jnp.sum(not_down, axis=1, dtype=jnp.int32) - not_down[
-                narange, narange
-            ].astype(jnp.int32)
-            members_up = jnp.sum(jnp.where(alive, cnt, 0))
-        else:
-            side = part.astype(jnp.int32)
-            cnt = jnp.sum(not_down, axis=1, dtype=jnp.int32)
-            self_nd = not_down[side, narange].astype(jnp.int32)
-            members_up = jnp.sum(jnp.where(alive, cnt[side] - self_nd, 0))
-        if p.swim:
-            probe_sends = jnp.sum(probing, dtype=jnp.int32)
-        else:
-            probe_sends = jnp.int32(0)
-        tel = {
-            "probe_sends": probe_sends,
-            "bcast_sends": tel_bcast,
-            "deliveries": tel_deliv,
-            "sync_sessions": tel_sync_sess,
-            "sync_chunks": tel_sync_chunks,
-            "complete_pairs": complete_pairs,
-            "nodes_complete": nodes_complete,
-            "budget_remaining": budget_remaining,
-            "members_up": members_up,
-            "views_up": jnp.sum(status == ALIVE, dtype=jnp.int32),
-            "views_suspect": jnp.sum(status == SUSPECT, dtype=jnp.int32),
-            "views_down": jnp.sum(status == DOWN, dtype=jnp.int32),
-            "n_alive": jnp.sum(alive, dtype=jnp.int32),
-            "n_restarted": jnp.sum(restarted, dtype=jnp.int32),
-            "part_active": jnp.asarray(part_active).astype(jnp.int32),
-        }
+        with phase_scope("telemetry"):
+            # 7. flight-recorder reductions on the POST-round planes (word
+            # space when packed); defined to match what the runtime's counters
+            # observe at a DevCluster round barrier (chaos/compare.py parity)
+            if p.packed:
+                notc = pack.lane_nonzero(cov ^ full_w[None, :], cb)
+                cflags = valid_w[None, :] & ~notc
+                complete_pairs = pack.popcount32(cflags).sum()
+                nodes_complete = jnp.sum(
+                    jnp.all(cflags == valid_w[None, :], axis=1), dtype=jnp.int32
+                )
+                budget_remaining = pack.lane_sum(budget, bb).sum()
+            else:
+                cmask = cov == full[None, :]
+                complete_pairs = jnp.sum(cmask, dtype=jnp.int32)
+                nodes_complete = jnp.sum(
+                    jnp.all(cmask, axis=1), dtype=jnp.int32
+                )
+                budget_remaining = jnp.sum(budget, dtype=jnp.int32)
+            # members_up: the sim twin of summing len(up_members()) over live
+            # runtime nodes — others not believed DOWN, through each live
+            # node's own view row (per-node) or its side's consensus view
+            not_down = status != DOWN
+            if per_node:
+                cnt = jnp.sum(not_down, axis=1, dtype=jnp.int32) - not_down[
+                    narange, narange
+                ].astype(jnp.int32)
+                members_up = jnp.sum(jnp.where(alive, cnt, 0))
+            else:
+                side = part.astype(jnp.int32)
+                cnt = jnp.sum(not_down, axis=1, dtype=jnp.int32)
+                self_nd = not_down[side, narange].astype(jnp.int32)
+                members_up = jnp.sum(jnp.where(alive, cnt[side] - self_nd, 0))
+            if p.swim:
+                probe_sends = jnp.sum(probing, dtype=jnp.int32)
+            else:
+                probe_sends = jnp.int32(0)
+            tel = {
+                "probe_sends": probe_sends,
+                "bcast_sends": tel_bcast,
+                "deliveries": tel_deliv,
+                "sync_sessions": tel_sync_sess,
+                "sync_chunks": tel_sync_chunks,
+                "complete_pairs": complete_pairs,
+                "nodes_complete": nodes_complete,
+                "budget_remaining": budget_remaining,
+                "members_up": members_up,
+                "views_up": jnp.sum(status == ALIVE, dtype=jnp.int32),
+                "views_suspect": jnp.sum(status == SUSPECT, dtype=jnp.int32),
+                "views_down": jnp.sum(status == DOWN, dtype=jnp.int32),
+                "n_alive": jnp.sum(alive, dtype=jnp.int32),
+                "n_restarted": jnp.sum(restarted, dtype=jnp.int32),
+                "part_active": jnp.asarray(part_active).astype(jnp.int32),
+            }
         return (cov, budget, status, since, r + 1), tel
 
     return step

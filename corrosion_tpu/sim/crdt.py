@@ -26,6 +26,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.annotate import phase_scope
 from .model import SimParams
 from .rng import TAG_INJECT, jx_below, py_below
 
@@ -52,26 +53,31 @@ def merge_registers(
     (0.5 GB at the 1M-node scale) never materializes.
     """
     K = p.n_changes
-    keys = change_keys(p, n_keys)
-    lamport = jx_below(
-        p.write_rounds, p.seed, TAG_INJECT, jnp.arange(K, dtype=jnp.int32)
-    )
-    stamp = lamport.astype(jnp.int32) * K + jnp.arange(K, dtype=jnp.int32)
-
-    def per_node(h):
-        if packed:
-            from . import pack as packmod
-
-            h = packmod.unpack_cov(h, p) != 0
-        vals = jnp.where(h, stamp, jnp.int32(-1))
-        reg = jax.ops.segment_max(
-            vals, keys, num_segments=n_keys, indices_are_sorted=False
+    with phase_scope("crdt_merge"):
+        keys = change_keys(p, n_keys)
+        lamport = jx_below(
+            p.write_rounds, p.seed, TAG_INJECT, jnp.arange(K, dtype=jnp.int32)
         )
-        reg = jnp.maximum(reg, jnp.int32(-1))  # empty segment → "no data"
-        cl = jax.ops.segment_sum(h.astype(jnp.int32), keys, num_segments=n_keys)
-        return reg, cl
+        stamp = (
+            lamport.astype(jnp.int32) * K + jnp.arange(K, dtype=jnp.int32)
+        )
 
-    return jax.vmap(per_node)(have)
+        def per_node(h):
+            if packed:
+                from . import pack as packmod
+
+                h = packmod.unpack_cov(h, p) != 0
+            vals = jnp.where(h, stamp, jnp.int32(-1))
+            reg = jax.ops.segment_max(
+                vals, keys, num_segments=n_keys, indices_are_sorted=False
+            )
+            reg = jnp.maximum(reg, jnp.int32(-1))  # empty seg → "no data"
+            cl = jax.ops.segment_sum(
+                h.astype(jnp.int32), keys, num_segments=n_keys
+            )
+            return reg, cl
+
+        return jax.vmap(per_node)(have)
 
 
 def merge_registers_py(have_sets, p: SimParams, n_keys: int):

@@ -60,6 +60,7 @@ from typing import Dict
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.annotate import phase_scope
 from . import pack
 from .model import SimParams
 
@@ -138,14 +139,18 @@ def segment_or(keys: jnp.ndarray, vals: jnp.ndarray, n_out: int) -> jnp.ndarray:
     see the module docstring).  Keys of empty rows still occupy a
     segment; their zero values are OR-identity, so padding rows are free.
     """
-    order = jnp.argsort(keys)
-    sk = jnp.take(keys, order)
-    sv = jnp.take(vals, order, axis=0)
-    start = jnp.ones(sk.shape, dtype=bool).at[1:].set(sk[1:] != sk[:-1])
-    flags = start.reshape(start.shape + (1,) * (sv.ndim - 1))
-    _, scanned = lax.associative_scan((_seg_or), (flags, sv))
-    out = jnp.zeros((n_out,) + sv.shape[1:], dtype=jnp.uint32)
-    return out.at[sk].max(scanned)
+    # self-scoped: broadcast applies stay frames_apply, while the sync
+    # session apply (called under the sync scope) attributes to sync —
+    # obs/attr.py takes the FIRST phase component on the op path
+    with phase_scope("frames_apply"):
+        order = jnp.argsort(keys)
+        sk = jnp.take(keys, order)
+        sv = jnp.take(vals, order, axis=0)
+        start = jnp.ones(sk.shape, dtype=bool).at[1:].set(sk[1:] != sk[:-1])
+        flags = start.reshape(start.shape + (1,) * (sv.ndim - 1))
+        _, scanned = lax.associative_scan((_seg_or), (flags, sv))
+        out = jnp.zeros((n_out,) + sv.shape[1:], dtype=jnp.uint32)
+        return out.at[sk].max(scanned)
 
 
 def apply_row_frame(
@@ -162,7 +167,8 @@ def apply_entry_frame(
     """Apply an entry frame: [M] int32 flat keys (``target·Wc + kword``)
     + [M] uint32 single-word values → [n_nodes, Wc] delivered words."""
     flat = segment_or(keys, vals, n_nodes * n_words)
-    return flat.reshape(n_nodes, n_words)
+    with phase_scope("frames_apply"):
+        return flat.reshape(n_nodes, n_words)
 
 
 def identity_frame_apply(
@@ -171,4 +177,5 @@ def identity_frame_apply(
     """Apply an identity-keyed frame (sync sessions: row n targets node
     n): the segment combine degenerates to a masked OR — no sort, no
     scan.  ``dst`` [N, W], ``ok`` bool[N], ``rows`` [N, W] same dtype."""
-    return jnp.where(ok[:, None], dst | rows, dst)
+    with phase_scope("frames_apply"):
+        return jnp.where(ok[:, None], dst | rows, dst)

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.annotate import phase_scope
 from .model import SimParams
 from .rng import TAG_NSEQ, TAG_ORIGIN, jx_below, py_below
 
@@ -244,17 +245,18 @@ def jx_available(
 ) -> jnp.ndarray:
     """[N, K] uint8: chunks the peer can serve us under the reference
     needs algebra (cases 1-3 in the module docstring)."""
-    miss = cov_theirs & ~cov_mine
-    head_per_k = jnp.take_along_axis(
-        heads_mine, jnp.asarray(aidx)[None, :], axis=1
-    )
-    above_head = jnp.asarray(vidx)[None, :] > head_per_k
-    theirs_complete = cov_theirs == full[None, :]
-    gap = cov_mine == 0  # nothing of this version (and not above head)
-    servable = jnp.where(
-        above_head | ~gap, miss, jnp.where(theirs_complete, miss, 0)
-    )
-    return servable.astype(jnp.uint8)
+    with phase_scope("sync"):
+        miss = cov_theirs & ~cov_mine
+        head_per_k = jnp.take_along_axis(
+            heads_mine, jnp.asarray(aidx)[None, :], axis=1
+        )
+        above_head = jnp.asarray(vidx)[None, :] > head_per_k
+        theirs_complete = cov_theirs == full[None, :]
+        gap = cov_mine == 0  # nothing of this version (not above head)
+        servable = jnp.where(
+            above_head | ~gap, miss, jnp.where(theirs_complete, miss, 0)
+        )
+        return servable.astype(jnp.uint8)
 
 
 def jx_available_nextmap(
@@ -272,15 +274,16 @@ def jx_available_nextmap(
     ascends with changeset id, so ``vidx[k] > head`` ⇔ no same-actor
     k' >= k has any coverage — exactly the suffix-OR of the seen flags.
     Bit-identical to :func:`jx_available` for concrete inputs."""
-    miss = cov_theirs & ~cov_mine
-    seen8 = (cov_mine > 0).astype(jnp.uint8)
-    above_head = _suffix_or_seen(seen8, nxt, steps) == 0
-    theirs_complete = cov_theirs == full[None, :]
-    gap = cov_mine == 0
-    servable = jnp.where(
-        above_head | ~gap, miss, jnp.where(theirs_complete, miss, 0)
-    )
-    return servable.astype(jnp.uint8)
+    with phase_scope("sync"):
+        miss = cov_theirs & ~cov_mine
+        seen8 = (cov_mine > 0).astype(jnp.uint8)
+        above_head = _suffix_or_seen(seen8, nxt, steps) == 0
+        theirs_complete = cov_theirs == full[None, :]
+        gap = cov_mine == 0
+        servable = jnp.where(
+            above_head | ~gap, miss, jnp.where(theirs_complete, miss, 0)
+        )
+        return servable.astype(jnp.uint8)
 
 
 def py_available(
@@ -342,30 +345,38 @@ def jx_available_packed(
     (tests/test_sim_pack.py)."""
     from . import pack
 
-    bits = pack.lane_bits(p)
-    lsb = jnp.uint32(pack.lane_lsb_mask(bits))
-    miss = theirs_w & ~mine_w
-    has_any = pack.lane_nonzero(mine_w, bits)
-    not_complete = pack.lane_nonzero(theirs_w ^ full_w[None, :], bits)
-    # seen flag per changeset: ANY coverage bit in the lane (a buffered
-    # partial raises the head even when seq 0 is still missing, matching
-    # jx_heads' cov > 0 rule) — gathered off has_any's lane-LSB flags
-    # (one fused gather+shift+mask; no [N, W, L] unpack temporaries)
-    kr = np.arange(p.n_changes)
-    kw = jnp.asarray((kr // pack.lanes_per_word(p)).astype(np.int32))
-    ksh = jnp.asarray((kr % pack.lanes_per_word(p)) * bits, dtype=np.uint32)
-    seen8 = ((has_any[:, kw] >> ksh[None, :]) & jnp.uint32(1)).astype(
-        jnp.uint8
-    )
-    if nxt is None:
-        nxt, steps = next_version_index(p)
-    # OR over seen[k'] for same-actor k' >= k (incl. self);
-    # vidx[k] > head  ⇔  no same-actor version >= vidx[k] is seen; the
-    # self term makes this false whenever seen[k] — which has_any then
-    # serves, exactly the dense rule's case split
-    above_head = _suffix_or_seen(seen8, nxt, steps) == 0
-    serve = pack.pack_flags(above_head, p) | has_any | (lsb & ~not_complete)
-    return miss & pack.lane_fill(serve, bits)
+    with phase_scope("sync"):
+        bits = pack.lane_bits(p)
+        lsb = jnp.uint32(pack.lane_lsb_mask(bits))
+        miss = theirs_w & ~mine_w
+        has_any = pack.lane_nonzero(mine_w, bits)
+        not_complete = pack.lane_nonzero(theirs_w ^ full_w[None, :], bits)
+        # seen flag per changeset: ANY coverage bit in the lane (a
+        # buffered partial raises the head even when seq 0 is still
+        # missing, matching jx_heads' cov > 0 rule) — gathered off
+        # has_any's lane-LSB flags (one fused gather+shift+mask; no
+        # [N, W, L] unpack temporaries)
+        kr = np.arange(p.n_changes)
+        kw = jnp.asarray((kr // pack.lanes_per_word(p)).astype(np.int32))
+        ksh = jnp.asarray(
+            (kr % pack.lanes_per_word(p)) * bits, dtype=np.uint32
+        )
+        seen8 = ((has_any[:, kw] >> ksh[None, :]) & jnp.uint32(1)).astype(
+            jnp.uint8
+        )
+        if nxt is None:
+            nxt, steps = next_version_index(p)
+        # OR over seen[k'] for same-actor k' >= k (incl. self);
+        # vidx[k] > head  ⇔  no same-actor version >= vidx[k] is seen;
+        # the self term makes this false whenever seen[k] — which
+        # has_any then serves, exactly the dense rule's case split
+        above_head = _suffix_or_seen(seen8, nxt, steps) == 0
+        serve = (
+            pack.pack_flags(above_head, p)
+            | has_any
+            | (lsb & ~not_complete)
+        )
+        return miss & pack.lane_fill(serve, bits)
 
 
 # -- budgeted (version, seq)-ordered transfer -------------------------------
@@ -376,14 +387,15 @@ def jx_budget_transfer(avail: jnp.ndarray, budget: int) -> jnp.ndarray:
     seq) order; budget <= 0 means unlimited."""
     if budget <= 0:
         return avail
-    pc = jx_popcount8(avail)
-    cum = jnp.cumsum(pc, axis=1)
-    prev = cum - pc
-    return jnp.where(
-        cum <= budget,
-        avail,
-        jx_lowest_bits(avail, budget - prev),
-    ).astype(jnp.uint8)
+    with phase_scope("sync"):
+        pc = jx_popcount8(avail)
+        cum = jnp.cumsum(pc, axis=1)
+        prev = cum - pc
+        return jnp.where(
+            cum <= budget,
+            avail,
+            jx_lowest_bits(avail, budget - prev),
+        ).astype(jnp.uint8)
 
 
 def py_budget_transfer(avail: Sequence[int], budget: int) -> List[int]:

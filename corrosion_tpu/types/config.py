@@ -226,6 +226,9 @@ class TelemetryConfig:
     # per-request HTTP timeout (seconds) for collector posts; failures
     # increment corro.otlp.export.errors (doc/telemetry.md)
     otlp_timeout: float = 5.0
+    # span ring-buffer size (utils/tracing.py); overflow evictions
+    # increment corro.trace.spans.dropped
+    span_buffer: int = 512
 
 
 @dataclass

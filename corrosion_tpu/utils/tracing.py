@@ -31,11 +31,14 @@ logger = logging.getLogger("corrosion_tpu.trace")
 __all__ = [
     "SpanRecord",
     "TraceContext",
+    "configure",
     "current_traceparent",
     "recent_spans",
     "span",
 ]
 
+# default ring size; operators size it via ``telemetry.span_buffer``
+# (types/config.py), applied at node start through :func:`configure`
 SPAN_BUFFER = 512
 
 
@@ -91,6 +94,23 @@ _current: contextvars.ContextVar[Optional[TraceContext]] = (
 _lock = threading.Lock()
 _spans: Deque[SpanRecord] = deque(maxlen=SPAN_BUFFER)
 _exporters: list = []  # objects with .enqueue(SpanRecord)
+
+
+def configure(span_buffer: int = SPAN_BUFFER) -> None:
+    """Resize the span ring buffer (``telemetry.span_buffer``), keeping
+    the newest records that still fit.  Idempotent for an unchanged
+    size, so concurrent node starts in one process don't thrash."""
+    global _spans
+    size = max(1, int(span_buffer))
+    with _lock:
+        if _spans.maxlen == size:
+            return
+        _spans = deque(_spans, maxlen=size)
+
+
+def span_buffer_size() -> int:
+    with _lock:
+        return int(_spans.maxlen or 0)
 
 
 def add_exporter(exporter) -> None:
@@ -150,8 +170,15 @@ def span(
         # it: exporters may block (file write), and holding _lock across
         # a slow enqueue would stall every thread closing a span
         with _lock:
+            # deque(maxlen=...) evicts silently; count the overflow so
+            # an undersized buffer is visible to operators
+            dropped = len(_spans) == _spans.maxlen
             _spans.append(record)
             exporters = list(_exporters)
+        if dropped:
+            from . import metrics
+
+            metrics.counter("corro.trace.spans.dropped").inc()
         for exporter in exporters:
             with contextlib.suppress(Exception):
                 exporter.enqueue(record)

@@ -120,6 +120,41 @@ jax.jit(seg)
     assert "GL101" in trace_rules(bad)
 
 
+def test_gl101_named_scope_annotation_not_flagged():
+    # the PR-19 phase-annotation idiom (obs/annotate.py): a metadata-only
+    # context manager wrapping traced code must stay clean — it neither
+    # branches on tracers nor leaves the trace
+    good = """
+import jax
+from corrosion_tpu.obs.annotate import phase_scope
+def step(state):
+    with phase_scope("sync"):
+        state = state + 1
+    with phase_scope("receive"):
+        state = state * 2
+    return state
+jax.jit(step)
+"""
+    assert trace_rules(good) == set()
+
+
+def test_gl101_host_branch_inside_named_scope_still_flagged():
+    # the scope does not launder a tracer branch: a Python `if` on a
+    # traced value inside `with phase_scope(...)` is the same bug
+    bad = """
+import jax
+from corrosion_tpu.obs.annotate import phase_scope
+def step(state):
+    with phase_scope("sync"):
+        gate = state[0].sum()
+        if gate:
+            state = state + 1
+    return state
+jax.jit(step)
+"""
+    assert "GL101" in trace_rules(bad)
+
+
 def test_gl101_rebatch_boundary_host_fetch_not_flagged():
     # the blessed idiom (fleet/run.py _run_fleet_compacted): run the
     # segment to completion, FETCH the mask with np.asarray (host
@@ -281,7 +316,9 @@ def test_gl401_scoped_to_device_program_dirs():
     business (DONATION_DIRS pins the scope)."""
     from corrosion_tpu.analysis import DONATION_DIRS
 
-    assert set(DONATION_DIRS) == {"sim", "crdt", "fleet", "pubsub/vmatch"}
+    assert set(DONATION_DIRS) == {
+        "sim", "crdt", "fleet", "pubsub/vmatch", "obs",
+    }
 
 
 def test_gl401_suppressible_with_reason():
@@ -510,6 +547,24 @@ def test_repo_lints_clean():
         f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings
     )
     assert exit_code(findings) == 0
+
+
+def test_obs_package_lints_clean_at_fail_on_warning():
+    """The observability package is in scope for BOTH device-program
+    passes (TRACE_SAFETY_DIRS and DONATION_DIRS include "obs") and must
+    hold the strictest bar: zero findings even at --fail-on warning."""
+    from corrosion_tpu.analysis import (
+        DONATION_DIRS,
+        TRACE_SAFETY_DIRS,
+        lint_paths,
+    )
+    from corrosion_tpu.analysis.rules import WARNING
+
+    assert "obs" in TRACE_SAFETY_DIRS and "obs" in DONATION_DIRS
+    findings = lint_paths([os.path.join(REPO, "corrosion_tpu", "obs")])
+    assert exit_code(findings, fail_on=WARNING) == 0, "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings
+    )
 
 
 def test_every_suppression_in_repo_carries_reason():

@@ -58,6 +58,59 @@ def test_histogram_timer():
     assert h.total == 1
 
 
+def test_prometheus_text_format_conformance():
+    """Exposition-format conformance (the Prometheus text format spec),
+    checked line by line: every histogram gets a +Inf bucket equal to
+    _count, _sum carries the observation sum, bucket counts are
+    cumulative, label values escape backslash/quote/newline, and metric
+    names are sanitized to [a-zA-Z0-9_:]."""
+    reg = MetricsRegistry()
+    h = reg.histogram("corro.conf.lat", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.7, 5.0):
+        h.observe(v)
+    reg.counter("corro.conf-dash.count", path='a\\b"c\nd').inc(2)
+    reg.gauge("corro.conf.gauge").set(-1.5)
+
+    text = reg.render_prometheus()
+    lines = text.splitlines()
+
+    # histogram: cumulative buckets, +Inf == _count, _sum == Σ observed
+    def value_of(prefix):
+        matches = [ln for ln in lines if ln.startswith(prefix)]
+        assert len(matches) == 1, (prefix, matches)
+        return float(matches[0].rsplit(" ", 1)[1])
+
+    b01 = value_of('corro_conf_lat_bucket{le="0.1"}')
+    b1 = value_of('corro_conf_lat_bucket{le="1"}')
+    binf = value_of('corro_conf_lat_bucket{le="+Inf"}')
+    assert b01 <= b1 <= binf
+    assert binf == value_of("corro_conf_lat_count") == 4
+    assert value_of("corro_conf_lat_sum") == 0.05 + 0.5 + 0.7 + 5.0
+
+    # TYPE lines precede their samples
+    assert lines.index("# TYPE corro_conf_lat histogram") < lines.index(
+        'corro_conf_lat_bucket{le="0.1"} 1'
+    )
+
+    # label escaping: backslash, double quote, newline per the spec
+    escaped = 'corro_conf_dash_count{path="a\\\\b\\"c\\nd"} 2'
+    assert escaped in lines
+    # samples are single-line: the raw newline never leaks into the body
+    assert all("\n" not in ln for ln in lines)
+
+    # name sanitization: dots and dashes become underscores everywhere
+    import re
+
+    for ln in lines:
+        name = ln.split("{")[0].split(" ")[1 if ln.startswith("#") else 0]
+        if ln.startswith("# TYPE"):
+            name = ln.split(" ")[2]
+        assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name), ln
+
+    # gauges render negative values verbatim
+    assert "corro_conf_gauge -1.5" in lines
+
+
 # ---------------------------------------------------------------------------
 # tracing
 # ---------------------------------------------------------------------------
